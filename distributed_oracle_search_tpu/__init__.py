@@ -1,0 +1,28 @@
+"""distributed_oracle_search_tpu — a TPU-native distributed pathfinding oracle.
+
+A from-scratch JAX/XLA (pjit / shard_map / pallas) framework with the
+capabilities of the reference system ``eggeek/distributed-oracle-search``:
+
+* precompute Compressed Path Databases (CPDs) — per-target first-move
+  shortest-path tables on a road network — sharded across workers by a node
+  partitioning function (reference: ``make_cpd_auto`` + OpenMP, launched over
+  ssh/tmux; here: batched min-plus Bellman-Ford sharded over a
+  ``jax.sharding.Mesh``), and
+* answer s–t shortest-path queries, optionally on a congestion-perturbed
+  graph, by routing each query to the shard owning its **target** node
+  (reference: resident ``fifo_auto --alg table-search`` C++ processes behind
+  named FIFOs + NFS; here: a vmapped first-move gather/scan answering an
+  entire scenario file in one XLA call).
+
+Package layout:
+
+``data/``      graph + scenario + diff file formats, synthetic road networks
+``parallel/``  partitioning (DistributionController) and device-mesh sharding
+``ops/``       JAX compute kernels (Bellman-Ford, first-move, table-search)
+``models/``    oracle model families (CPD oracle, CPU reference oracles)
+``runtime/``   resident servers, wire protocol, cluster launch
+``cli/``       drivers mirroring the reference entry points
+``utils/``     timers, config, logging
+"""
+
+__version__ = "0.1.0"

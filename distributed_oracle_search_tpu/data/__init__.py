@@ -1,0 +1,12 @@
+from .graph import Graph
+from .formats import (
+    read_xy, write_xy, read_scen, write_scen, read_diff, write_diff,
+    xy_node_count,
+)
+from .synth import synth_city_graph, synth_scenario, synth_diff
+
+__all__ = [
+    "Graph", "read_xy", "write_xy", "read_scen", "write_scen",
+    "read_diff", "write_diff", "xy_node_count",
+    "synth_city_graph", "synth_scenario", "synth_diff",
+]

@@ -1,0 +1,88 @@
+"""Deterministic synthetic road networks, scenarios, and congestion diffs.
+
+The reference's data files (``data/melb-both.xy``, ``.diff``, ``full.scen``)
+were stripped from the snapshot (``/root/reference/.MISSING_LARGE_BLOBS``), so
+benchmarks and tests run on generated city-like graphs instead: a W×H street
+grid with jittered coordinates, integer travel times proportional to jittered
+euclidean length, optional random arterial shortcuts, and every street
+two-way — which keeps the graph strongly connected by construction, like a
+real road network under the free-flow assumption.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .graph import Graph
+
+
+def synth_city_graph(width: int, height: int, seed: int = 0,
+                     shortcut_frac: float = 0.02,
+                     weight_jitter: float = 0.3) -> Graph:
+    """Grid city: ``width*height`` intersections, two-way streets.
+
+    Travel times are int32 in ~[80, 160] per block edge (scaled euclidean
+    with multiplicative jitter). ``shortcut_frac`` adds that fraction of
+    extra random two-way "arterial" edges with proportionally longer times.
+    """
+    rng = np.random.default_rng(seed)
+    n = width * height
+    ids = np.arange(n, dtype=np.int64)
+    gx, gy = ids % width, ids // width
+    xs = gx * 100 + rng.integers(-20, 21, n)
+    ys = gy * 100 + rng.integers(-20, 21, n)
+
+    # grid streets: right and up neighbors, both directions
+    right = ids[gx < width - 1]
+    up = ids[gy < height - 1]
+    su = np.concatenate([right, up])
+    sv = np.concatenate([right + 1, up + width])
+
+    if shortcut_frac > 0 and n > 4:
+        k = int(len(su) * shortcut_frac)
+        a = rng.integers(0, n, k)
+        hop = rng.integers(2, 6, k)
+        b = np.clip(a + hop * rng.choice([1, -1, width, -width], k), 0, n - 1)
+        keep = a != b
+        su = np.concatenate([su, a[keep]])
+        sv = np.concatenate([sv, b[keep]])
+
+    # both directions
+    src = np.concatenate([su, sv])
+    dst = np.concatenate([sv, su])
+    # drop duplicate directed edges (shortcuts may collide with grid edges)
+    key = src * n + dst
+    _, uniq = np.unique(key, return_index=True)
+    src, dst = src[uniq], dst[uniq]
+
+    dx = xs[src] - xs[dst]
+    dy = ys[src] - ys[dst]
+    dist = np.sqrt((dx * dx + dy * dy).astype(np.float64))
+    jitter = 1.0 + weight_jitter * rng.random(len(src))
+    w = np.maximum(1, (dist * jitter).astype(np.int64)).astype(np.int32)
+    return Graph(xs, ys, src, dst, w)
+
+
+def synth_scenario(n_nodes: int, n_queries: int, seed: int = 1) -> np.ndarray:
+    """Random s–t pairs with s != t, int64 [Q, 2]."""
+    rng = np.random.default_rng(seed)
+    s = rng.integers(0, n_nodes, n_queries)
+    t = rng.integers(0, n_nodes, n_queries)
+    clash = s == t
+    t[clash] = (t[clash] + 1) % n_nodes
+    return np.stack([s, t], axis=1).astype(np.int64)
+
+
+def synth_diff(graph: Graph, frac: float = 0.1, seed: int = 2,
+               factor_range: tuple[float, float] = (1.5, 4.0)):
+    """Congestion diff: slow down a random ``frac`` of edges.
+
+    Returns ``(src, dst, new_w)`` suitable for ``write_diff`` /
+    ``Graph.weights_with_diff``.
+    """
+    rng = np.random.default_rng(seed)
+    k = max(1, int(graph.m * frac))
+    eids = rng.choice(graph.m, size=k, replace=False)
+    factor = rng.uniform(*factor_range, k)
+    new_w = np.maximum(1, (graph.w[eids] * factor).astype(np.int64)).astype(np.int32)
+    return graph.src[eids], graph.dst[eids], new_w

@@ -1,0 +1,3 @@
+from .partition import DistributionController
+
+__all__ = ["DistributionController"]

@@ -1,0 +1,145 @@
+"""Node→worker partitioning: the ``DistributionController``.
+
+Role parity: the reference keeps its partition policy in a C++ header
+(``src/util/distribution_controller.h``, reference ``README.md:31-34,75-80``)
+exposed to Python only through the ``gen_distribute_conf`` binary, whose
+stdout — a header line plus one ``node,wid,bid,bidx`` CSV row per node — is
+parsed by the driver (reference ``process_query.py:46-53``). Passing the same
+``(partmethod, partkey, workerid, maxworker)`` quadruple to the CPD builder,
+the query servers, and the router is how build-time sharding and query-time
+routing stay consistent.
+
+Here the controller is a pure, vectorized Python function of
+``(nodenum, maxworker, partmethod, partkey)`` — no subprocess hop — and it is
+the exact seam where ``partmethod="tpu"`` lands: TPU partitions are
+contiguous node chunks that map 1:1 onto ``jax.sharding.Mesh`` shards, so a
+sharded ``[targets, N]`` first-move array indexed by *global target id* is
+automatically laid out with each worker's rows on its own device.
+
+Partition semantics (executable spec: reference ``offline.py:50-63``;
+README.md:31-33):
+
+* ``div``:   ``wid = node // partkey``
+* ``mod``:   ``wid = node %  partkey``
+* ``alloc``: ``wid = first i such that partkey[i] > node`` (partkey is a list
+             of ascending exclusive upper bounds, one per worker)
+* ``tpu``:   ``wid = node // ceil(nodenum / maxworker)`` — contiguous chunks
+             sized to the mesh (partkey ignored)
+
+Block structure: each worker's owned nodes, in ascending order, are split
+into blocks of ``block_size``; ``bid`` is the block id and ``bidx`` the index
+within the block (the reference's CPD builder emits one file per block:
+``README.md:92``, and ``bid``/``bidx`` appear in ``gen_distribute_conf``
+output). ``bid * block_size + bidx`` is the node's dense **owned index** —
+its row in the worker's CPD shard.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_BLOCK_SIZE = 1 << 14
+
+
+class DistributionController:
+    def __init__(self, partmethod: str, partkey, maxworker: int,
+                 nodenum: int, block_size: int = DEFAULT_BLOCK_SIZE):
+        self.partmethod = partmethod
+        self.partkey = partkey
+        self.maxworker = int(maxworker)
+        self.nodenum = int(nodenum)
+        self.block_size = int(block_size)
+        if self.maxworker <= 0:
+            raise ValueError("maxworker must be positive")
+        self._wid = self._assign_all()
+        # dense owned index per node: position within its owner's ascending
+        # owned-node list. Vectorized: stable argsort by (wid, node).
+        order = np.argsort(self._wid, kind="stable")
+        owned_idx = np.empty(self.nodenum, np.int64)
+        counts = np.bincount(self._wid, minlength=self.maxworker)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        owned_idx[order] = np.arange(self.nodenum) - np.repeat(starts, counts)
+        self._owned_idx = owned_idx
+        self._counts = counts
+
+    # ------------------------------------------------------------ policy
+    def _assign_all(self) -> np.ndarray:
+        nodes = np.arange(self.nodenum, dtype=np.int64)
+        m = self.partmethod
+        if m == "div":
+            wid = nodes // int(self.partkey)
+        elif m == "mod":
+            wid = nodes % int(self.partkey)
+        elif m == "alloc":
+            bounds = np.asarray(self.partkey, np.int64)
+            if np.any(np.diff(bounds) <= 0):
+                raise ValueError("alloc bounds must be strictly ascending")
+            wid = np.searchsorted(bounds, nodes, side="right")
+        elif m == "tpu":
+            chunk = -(-self.nodenum // self.maxworker)  # ceil div
+            wid = nodes // chunk
+        else:
+            raise ValueError(f"unknown partmethod {m!r}")
+        if self.nodenum and (wid.min() < 0 or wid.max() >= self.maxworker):
+            raise ValueError(
+                f"partmethod={m} partkey={self.partkey} maps some node to "
+                f"worker {int(wid.max())} but maxworker={self.maxworker}")
+        return wid.astype(np.int64)
+
+    # ------------------------------------------------------------ queries
+    def worker_of(self, nodes) -> np.ndarray:
+        """wid for each node (vectorized)."""
+        return self._wid[np.asarray(nodes, np.int64)]
+
+    def owned_index_of(self, nodes) -> np.ndarray:
+        """Dense row index of each node within its owner's CPD shard."""
+        return self._owned_idx[np.asarray(nodes, np.int64)]
+
+    def owned(self, wid: int) -> np.ndarray:
+        """Ascending node ids owned by ``wid``."""
+        return np.nonzero(self._wid == wid)[0].astype(np.int64)
+
+    def n_owned(self, wid: int) -> int:
+        return int(self._counts[wid])
+
+    @property
+    def max_owned(self) -> int:
+        """Largest shard size — the padded per-device row count in TPU mode."""
+        return int(self._counts.max()) if self.nodenum else 0
+
+    def table(self) -> np.ndarray:
+        """int64 [N, 4] rows of (node, wid, bid, bidx) — the
+        ``gen_distribute_conf`` payload."""
+        nodes = np.arange(self.nodenum, dtype=np.int64)
+        bid = self._owned_idx // self.block_size
+        bidx = self._owned_idx % self.block_size
+        return np.stack([nodes, self._wid, bid, bidx], axis=1)
+
+    def format_conf(self) -> str:
+        """The wire format the reference driver parses: one header line, then
+        ``node,wid,bid,bidx`` per node (reference ``process_query.py:50-53``)."""
+        rows = self.table()
+        lines = ["node,wid,bid,bidx"]
+        lines += [f"{a},{b},{c},{d}" for a, b, c, d in rows]
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------ routing
+    def group_queries(self, queries: np.ndarray, active_worker: int = -1):
+        """Group (s, t) queries by the worker owning the **target** node — the
+        system invariant (reference ``process_query.py:56-57``).
+
+        Returns ``{wid: int64 [q, 2] array}`` with empty groups omitted, like
+        the reference's parts list skips empty workers
+        (``process_query.py:62``). ``active_worker`` restricts to one worker
+        (the ``-w`` flag), -1 = all.
+        """
+        queries = np.asarray(queries, np.int64)
+        wids = self.worker_of(queries[:, 1])
+        groups = {}
+        for wid in range(self.maxworker):
+            if active_worker != -1 and wid != active_worker:
+                continue
+            mask = wids == wid
+            if mask.any():
+                groups[wid] = queries[mask]
+        return groups

@@ -1,0 +1,4 @@
+from .timer import Timer
+from .log import get_logger, set_verbosity
+
+__all__ = ["Timer", "get_logger", "set_verbosity"]

@@ -1,0 +1,38 @@
+"""Logging helpers.
+
+The reference drives a module logger off a counted ``-v`` flag
+(``args.py:7,190-196``); we do the same but per-named-logger and without
+touching the host application's root logger at import time (library
+convention: handlers are attached to our own namespace only).
+"""
+
+from __future__ import annotations
+
+import logging
+
+_ROOT = "dos_tpu"
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
+
+
+def _ensure_handler(root: logging.Logger) -> None:
+    if not root.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+        root.addHandler(handler)
+        root.propagate = False
+
+
+def set_verbosity(verbose: int) -> None:
+    """Map a counted -v flag to a log level (0→WARN, 1→INFO, ≥2→DEBUG)."""
+    root = logging.getLogger(_ROOT)
+    _ensure_handler(root)
+    level = logging.WARNING
+    if verbose == 1:
+        level = logging.INFO
+    elif verbose >= 2:
+        level = logging.DEBUG
+    root.setLevel(level)

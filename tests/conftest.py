@@ -1,0 +1,31 @@
+"""Test environment: force JAX onto 8 virtual CPU devices.
+
+This is the framework's no-cluster analog of the reference's ``-t`` smoke
+mode (N× ``localhost`` workers, reference ``README.md:29``): a single host
+pretending to be an 8-shard mesh, per SURVEY.md §4. Must run before anything
+imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import synth_city_graph, synth_scenario
+
+
+@pytest.fixture(scope="session")
+def toy_graph():
+    """8x6 city grid — small enough for O(N^2) golden oracles."""
+    return synth_city_graph(8, 6, seed=7)
+
+
+@pytest.fixture(scope="session")
+def toy_queries(toy_graph):
+    return synth_scenario(toy_graph.n, 64, seed=11)

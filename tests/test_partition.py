@@ -1,0 +1,102 @@
+"""DistributionController: property tests against the executable spec
+(reference offline.py:50-63) and the wire format (process_query.py:46-53)."""
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.parallel import DistributionController
+
+
+def spec_wid(node, method, key):
+    # transliteration of the reference's Python partition spec semantics
+    if method == "div":
+        return node // key
+    if method == "mod":
+        return node % key
+    if method == "alloc":
+        return next(i for i, bound in enumerate(key) if bound > node)
+    raise ValueError(method)
+
+
+@pytest.mark.parametrize("method,key,maxworker", [
+    ("mod", 8, 8),
+    ("mod", 3, 8),
+    ("div", 13, 8),   # 13*8 >= 100
+    ("alloc", [10, 25, 60, 100], 4),
+])
+def test_matches_spec(method, key, maxworker):
+    n = 100
+    dc = DistributionController(method, key, maxworker, n)
+    for node in range(n):
+        assert dc.worker_of([node])[0] == spec_wid(node, method, key)
+
+
+def test_div_out_of_range_raises():
+    with pytest.raises(ValueError):
+        DistributionController("div", 10, 4, 100)  # node 99 -> wid 9 >= 4
+
+
+def test_tpu_contiguous_chunks():
+    dc = DistributionController("tpu", None, 4, 103)
+    wids = dc.worker_of(np.arange(103))
+    # contiguous, ascending, covers all workers, balanced to +-1 chunk
+    assert np.all(np.diff(wids) >= 0)
+    assert wids.max() == 3
+    chunk = -(-103 // 4)
+    assert np.all(wids == np.arange(103) // chunk)
+
+
+@pytest.mark.parametrize("method,key", [("mod", 8), ("div", 13), ("tpu", None)])
+def test_owned_index_dense(method, key):
+    n = 100
+    dc = DistributionController(method, key, 8, n)
+    for wid in range(8):
+        owned = dc.owned(wid)
+        assert dc.n_owned(wid) == len(owned)
+        # ascending node order, dense owned indices 0..k-1
+        assert np.all(np.diff(owned) > 0)
+        np.testing.assert_array_equal(
+            dc.owned_index_of(owned), np.arange(len(owned)))
+    # every node owned exactly once
+    total = sum(dc.n_owned(w) for w in range(8))
+    assert total == n
+
+
+def test_table_and_wire_format():
+    dc = DistributionController("mod", 4, 4, 12, block_size=2)
+    tab = dc.table()
+    assert tab.shape == (12, 4)
+    # bid/bidx consistent with owned index and block size
+    np.testing.assert_array_equal(
+        tab[:, 2] * 2 + tab[:, 3], dc.owned_index_of(np.arange(12)))
+    # wire format: header + one CSV row per node, parseable the way the
+    # reference driver parses gen_distribute_conf output
+    lines = dc.format_conf().split("\n")
+    assert len(lines) == 13
+    node2worker = {}
+    for l in lines[1:]:
+        node, wid, bid, bidx = map(int, l.split(","))
+        node2worker[node] = wid
+    assert node2worker == {i: i % 4 for i in range(12)}
+
+
+def test_group_queries_by_target_owner():
+    dc = DistributionController("mod", 4, 4, 100)
+    qs = np.array([[1, 2], [3, 6], [5, 2], [0, 7], [9, 11]])
+    groups = dc.group_queries(qs)
+    # invariant: every query lands on the worker owning its *target*
+    for wid, part in groups.items():
+        assert np.all(dc.worker_of(part[:, 1]) == wid)
+    assert sum(len(p) for p in groups.values()) == len(qs)
+    # active-worker restriction (-w flag semantics)
+    only2 = dc.group_queries(qs, active_worker=2)
+    assert list(only2) == [2]
+    np.testing.assert_array_equal(only2[2], [[1, 2], [3, 6], [5, 2]])
+
+
+def test_balanced_partitions_mod_vs_tpu():
+    n = 1000
+    for method, key in [("mod", 8), ("tpu", None)]:
+        dc = DistributionController(method, key, 8, n)
+        counts = [dc.n_owned(w) for w in range(8)]
+        assert max(counts) - min(counts) <= -(-n // 8)

@@ -1,0 +1,99 @@
+"""CPU reference oracle: Dijkstra / first-move / table-search invariants.
+
+These are the golden semantics every other backend (TPU ops, native C++) is
+tested against, so they get their own sanity checks: triangle inequality,
+walk-cost == shortest-dist on free-flow weights, diff behavior.
+"""
+
+import numpy as np
+
+from distributed_oracle_search_tpu.data import synth_diff
+from distributed_oracle_search_tpu.data.graph import INF
+from distributed_oracle_search_tpu.models import (
+    dijkstra, dist_to_target, first_move_matrix, table_search_walk,
+)
+
+
+def test_dijkstra_forward_reverse_symmetry(toy_graph):
+    g = toy_graph
+    s, t = 3, g.n - 2
+    assert dijkstra(g, s)[t] == dijkstra(g, t, reverse=True)[s]
+
+
+def test_first_move_walk_reproduces_shortest_dist(toy_graph):
+    g = toy_graph
+    targets = np.arange(g.n)
+    fm = first_move_matrix(g, targets)          # [T=N, N] slots
+    assert fm.dtype == np.int8
+
+    rng = np.random.default_rng(0)
+    for _ in range(30):
+        s, t = rng.integers(0, g.n, 2)
+        d = dist_to_target(g, int(t))
+        cost, plen, finished, path = table_search_walk(
+            g, lambda x, tt: fm[tt, x], int(s), int(t))
+        if s == t:
+            assert cost == 0 and finished
+            continue
+        assert finished, f"walk {s}->{t} did not finish"
+        assert cost == d[s], "free-flow walk cost must equal shortest dist"
+        assert path[0] == s and path[-1] == t
+        assert plen == len(path) - 1
+
+
+def test_first_move_self_is_minus_one(toy_graph):
+    g = toy_graph
+    fm = first_move_matrix(g, np.arange(g.n))
+    assert np.all(fm[np.arange(g.n), np.arange(g.n)] == -1)
+
+
+def test_walk_on_perturbed_weights(toy_graph):
+    # Diff changes query-time cost but not the route (reference semantics:
+    # first moves stay free-flow, cost accumulates on perturbed weights).
+    g = toy_graph
+    ds, dd, dw = synth_diff(g, frac=0.3, seed=9)
+    w_query = g.weights_with_diff((ds, dd, dw))
+    fm = first_move_matrix(g, np.arange(g.n))
+
+    rng = np.random.default_rng(1)
+    for _ in range(10):
+        s, t = rng.integers(0, g.n, 2)
+        if s == t:
+            continue
+        c0, p0, f0, path0 = table_search_walk(
+            g, lambda x, tt: fm[tt, x], int(s), int(t))
+        c1, p1, f1, path1 = table_search_walk(
+            g, lambda x, tt: fm[tt, x], int(s), int(t), w_query=w_query)
+        assert path0 == path1          # same route
+        assert f1 and p1 == p0
+        assert c1 >= c0                # congestion only slows down
+
+
+def test_k_moves_bounds_walk(toy_graph):
+    g = toy_graph
+    fm = first_move_matrix(g, np.arange(g.n))
+    # find a pair with plen >= 3
+    rng = np.random.default_rng(2)
+    for _ in range(50):
+        s, t = rng.integers(0, g.n, 2)
+        _, plen, fin, _ = table_search_walk(g, lambda x, tt: fm[tt, x],
+                                            int(s), int(t))
+        if fin and plen >= 3:
+            break
+    c, p, fin, path = table_search_walk(g, lambda x, tt: fm[tt, x],
+                                        int(s), int(t), k_moves=2)
+    assert p == 2 and not fin and len(path) == 3
+
+
+def test_unreachable_reports_inf():
+    # two disconnected 2-node islands
+    from distributed_oracle_search_tpu.data.graph import Graph
+    g = Graph(xs=[0, 1, 5, 6], ys=[0, 0, 0, 0],
+              src=[0, 1, 2, 3], dst=[1, 0, 3, 2], w=[1, 1, 1, 1])
+    d = dist_to_target(g, 3)
+    assert d[0] == INF and d[1] == INF and d[2] == 1 and d[3] == 0
+    fm = first_move_matrix(g, np.array([3]))
+    assert fm[0, 0] == -1 and fm[0, 1] == -1  # unreachable -> no move
+    cost, plen, fin, _ = table_search_walk(
+        g, lambda x, tt: fm[0, x], 0, 3)
+    assert not fin and plen == 0
