@@ -1,0 +1,206 @@
+"""The CPD oracle model: sharded build, persistence, routed batched query.
+
+This is the framework's flagship "model": the Compressed Path Database —
+a ``[W, R, N]`` int8 first-move tensor (worker × owned-target-row × node),
+axis 0 sharded over the mesh's ``worker`` axis. It bundles the three phases
+the reference spreads over ``make_cpd_auto`` / CPD block files /
+``fifo_auto`` (SURVEY.md §3):
+
+* ``build()``   — sharded batched min-plus Bellman-Ford (reference: per-node
+                  Dijkstra sweeps per worker, ``README.md:95``),
+* ``save()`` / ``load()`` — per-(worker, block) ``.npy`` files + an
+  ``index.json`` manifest. The CPD index *is* the system checkpoint: build
+  once, serve statelessly, reload on restart (reference ``README.md:35,92``,
+  ``make_fifos.py:21``; SURVEY.md §5 checkpoint/resume). Blocks follow the
+  controller's ``bid``/``bidx`` scheme, so a partial build can resume at
+  block granularity.
+* ``query()``   — routes each (s, t) to the shard owning t (the invariant of
+                  ``process_query.py:56-57``), walks all queries in one XLA
+                  call, and scatters results back to input order.
+
+On HBM the table is deliberately **uncompressed** — the reference's
+run-length compression trades lookups for pointer chasing, which is exactly
+wrong for TPU; sharding is the compression here (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.graph import Graph
+from ..ops import DeviceGraph
+from ..parallel.mesh import (
+    make_mesh, worker_sharding, WORKER_AXIS, DATA_AXIS,
+)
+from ..parallel.partition import DistributionController
+from ..parallel.sharded import pad_targets, build_fm_sharded, query_sharded
+
+INDEX_VERSION = 1
+
+
+class CPDOracle:
+    def __init__(self, graph: Graph, controller: DistributionController,
+                 mesh=None):
+        self.graph = graph
+        self.dc = controller
+        self.mesh = mesh if mesh is not None else make_mesh(
+            n_workers=min(controller.maxworker, len(jax.devices())))
+        if self.mesh.shape[WORKER_AXIS] != controller.maxworker:
+            raise ValueError(
+                f"mesh worker axis {self.mesh.shape[WORKER_AXIS]} != "
+                f"maxworker {controller.maxworker}; partmethod=tpu requires "
+                "one mesh shard per worker")
+        self.dg = DeviceGraph.from_graph(graph)
+        self.targets_wr = pad_targets(controller)
+        self.fm = None  # int8 [W, R, N], sharded on worker axis
+
+    # ------------------------------------------------------------- build
+    def build(self, chunk: int = 0, max_iters: int = 0) -> "CPDOracle":
+        """Precompute all first-move rows, sharded over the mesh."""
+        self.fm = build_fm_sharded(self.dg, self.targets_wr, self.mesh,
+                                   chunk=chunk, max_iters=max_iters)
+        return self
+
+    # ------------------------------------------------------- persistence
+    def save(self, outdir: str) -> None:
+        """Write the CPD index: one .npy per (worker, block) + manifest."""
+        if self.fm is None:
+            raise RuntimeError("build() or load() before save()")
+        os.makedirs(outdir, exist_ok=True)
+        fm = np.asarray(self.fm)
+        bs = self.dc.block_size
+        files = []
+        for wid in range(self.dc.maxworker):
+            n_owned = self.dc.n_owned(wid)
+            for b0 in range(0, n_owned, bs):
+                bid = b0 // bs
+                rows = fm[wid, b0:min(b0 + bs, n_owned)]
+                fname = f"cpd-w{wid:05d}-b{bid:05d}.npy"
+                np.save(os.path.join(outdir, fname), rows)
+                files.append(fname)
+        manifest = {
+            "version": INDEX_VERSION,
+            "nodenum": self.dc.nodenum,
+            "maxworker": self.dc.maxworker,
+            "partmethod": self.dc.partmethod,
+            "partkey": (list(self.dc.partkey)
+                        if isinstance(self.dc.partkey, (list, tuple))
+                        else self.dc.partkey),
+            "block_size": bs,
+            "rows_per_worker": int(self.targets_wr.shape[1]),
+            "files": files,
+        }
+        with open(os.path.join(outdir, "index.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+
+    def load(self, outdir: str) -> "CPDOracle":
+        """Load a saved index onto the mesh, validating partition consistency
+        (the reference keeps build and serve consistent by passing the same
+        partmethod/partkey quadruple everywhere; we verify it)."""
+        with open(os.path.join(outdir, "index.json")) as f:
+            manifest = json.load(f)
+        my_partkey = (list(self.dc.partkey)
+                      if isinstance(self.dc.partkey, (list, tuple))
+                      else self.dc.partkey)
+        for key, mine in (("nodenum", self.dc.nodenum),
+                          ("maxworker", self.dc.maxworker),
+                          ("partmethod", self.dc.partmethod),
+                          ("partkey", my_partkey),
+                          ("block_size", self.dc.block_size)):
+            if manifest[key] != mine:
+                raise ValueError(
+                    f"index {outdir} was built with {key}={manifest[key]}, "
+                    f"controller has {mine}")
+        w = self.dc.maxworker
+        r = self.targets_wr.shape[1]
+        fm = np.full((w, r, self.graph.n), -1, np.int8)
+        bs = self.dc.block_size
+        for fname in manifest["files"]:
+            stem = fname[:-len(".npy")]
+            _, wpart, bpart = stem.split("-")
+            wid, bid = int(wpart[1:]), int(bpart[1:])
+            rows = np.load(os.path.join(outdir, fname))
+            fm[wid, bid * bs: bid * bs + len(rows)] = rows
+        self.fm = jax.device_put(fm, worker_sharding(self.mesh, rank=3))
+        return self
+
+    # ------------------------------------------------------------- query
+    def route(self, queries: np.ndarray, active_worker: int = -1):
+        """Pack (s, t) queries into mesh-shaped [D, W, Q] arrays.
+
+        Returns ``(t_rows, s, t, valid, scatter)`` where ``scatter`` maps
+        each input query to its (d, w, q) slot for unpacking results.
+        """
+        queries = np.asarray(queries, np.int64)
+        nq = len(queries)
+        d = self.mesh.shape[DATA_AXIS]
+        w = self.dc.maxworker
+        wids = self.dc.worker_of(queries[:, 1])
+        rows = self.dc.owned_index_of(queries[:, 1])
+
+        active = np.ones(nq, bool) if active_worker == -1 \
+            else wids == active_worker
+        # round-robin each worker's queries over the data axis (vectorized):
+        # the k-th query of worker w goes to data slot k % d, column k // d
+        slot_d = np.zeros(nq, np.int64)
+        slot_q = np.zeros(nq, np.int64)
+        idxs = np.nonzero(active)[0][np.argsort(wids[active], kind="stable")]
+        wids_sorted = wids[idxs]
+        group_sizes = np.bincount(wids_sorted, minlength=w)
+        starts = np.concatenate([[0], np.cumsum(group_sizes)[:-1]])
+        seq = np.arange(len(idxs)) - np.repeat(starts, group_sizes)
+        slot_d[idxs] = seq % d
+        slot_q[idxs] = seq // d
+        qmax = max(int(np.ceil(group_sizes.max() / d)) if len(idxs) else 0, 1)
+        # bucket the padded length to the next power of two: stable shapes
+        # across calls -> no recompilation when the batch mix shifts
+        qmax = 1 << (qmax - 1).bit_length()
+
+        s_arr = np.zeros((d, w, qmax), np.int32)
+        t_arr = np.zeros((d, w, qmax), np.int32)
+        r_arr = np.zeros((d, w, qmax), np.int32)
+        valid = np.zeros((d, w, qmax), bool)
+        s_arr[slot_d[active], wids[active], slot_q[active]] = queries[active, 0]
+        t_arr[slot_d[active], wids[active], slot_q[active]] = queries[active, 1]
+        r_arr[slot_d[active], wids[active], slot_q[active]] = rows[active]
+        valid[slot_d[active], wids[active], slot_q[active]] = True
+        scatter = (active, slot_d, wids, slot_q)
+        return r_arr, s_arr, t_arr, valid, scatter
+
+    def query(self, queries: np.ndarray, w_query: np.ndarray | None = None,
+              k_moves: int = -1, active_worker: int = -1,
+              max_steps: int = 0):
+        """Answer queries in input order.
+
+        ``w_query``: perturbed edge weights (file order), None = free flow.
+        Returns ``(cost, plen, finished)`` int64/bool arrays [Q]; queries
+        outside ``active_worker`` (when set) come back cost 0 / unfinished,
+        like the reference's ``-w`` filter drops them
+        (``process_query.py:59``).
+        """
+        if self.fm is None:
+            raise RuntimeError("build() or load() before query()")
+        r_arr, s_arr, t_arr, valid, scatter = self.route(
+            queries, active_worker)
+        # free-flow weights are already device-resident; only diffed runs
+        # pay a fresh host->device upload
+        w_pad = self.dg.w_pad if w_query is None else jnp.asarray(
+            self.graph.padded_weights(w_query), jnp.int32)
+        cost, plen, fin = query_sharded(
+            self.dg, self.fm, r_arr, s_arr, t_arr, valid, w_pad, self.mesh,
+            k_moves=k_moves, max_steps=max_steps)
+        cost, plen, fin = map(np.asarray, (cost, plen, fin))
+        nq = len(queries)
+        active, sd, sw, sq = scatter
+        out_c = np.zeros(nq, np.int64)
+        out_p = np.zeros(nq, np.int64)
+        out_f = np.zeros(nq, bool)
+        out_c[active] = cost[sd[active], sw[active], sq[active]]
+        out_p[active] = plen[sd[active], sw[active], sq[active]]
+        out_f[active] = fin[sd[active], sw[active], sq[active]]
+        return out_c, out_p, out_f

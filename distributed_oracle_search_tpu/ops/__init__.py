@@ -1,0 +1,8 @@
+from .device_graph import DeviceGraph
+from .bellman_ford import dist_to_targets, first_move_from_dist, build_fm_columns
+from .table_search import table_search_batch
+
+__all__ = [
+    "DeviceGraph", "dist_to_targets", "first_move_from_dist",
+    "build_fm_columns", "table_search_batch",
+]

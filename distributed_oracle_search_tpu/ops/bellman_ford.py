@@ -1,0 +1,120 @@
+"""CPD construction: batched min-plus Bellman-Ford + first-move extraction.
+
+TPU-native re-expression of the reference's CPD build, which runs one
+Dijkstra sweep per owned node under OpenMP (reference ``README.md:88-95``,
+``make_cpds.py:20``). Frontier Dijkstra is pointer-chasing and
+priority-queue bound — hostile to XLA — so the build is reformulated as
+**min-plus fixed-point iteration over a whole batch of targets at once**
+(SURVEY.md §7 stage 3):
+
+    dist[b, x]  <-  min(dist[b, x],  min_k  w[eid[x, k]] + dist[b, nbr[x, k]])
+
+where ``nbr/eid`` is the padded ELL out-edge table. Each iteration is one
+dense gather + min-reduce over ``[B, N, K]`` — static shapes, fully
+vectorized over the batch axis, bandwidth-bound on HBM, and XLA fuses the
+add/min into the gather. Convergence (no update anywhere in the batch) exits
+a ``lax.while_loop``; the iteration count is the max shortest-path *hop*
+length, ~graph diameter.
+
+First moves then fall out in one more pass: the argmin slot of the same
+relaxation expression, ties to the smallest slot — matching the CPU oracle's
+tie-break exactly (``models.reference.first_move_to_target``).
+
+Distances are directed **node→target** costs: the recurrence gathers over
+*out*-edges, so ``dist[b, x] = d(x → targets[b])``, which is precisely the
+quantity the target-owning worker needs (queries route by target,
+reference ``process_query.py:56-57``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .device_graph import DeviceGraph, JINF
+
+
+def _relax_nb(dist_nb: jnp.ndarray, dg: DeviceGraph) -> jnp.ndarray:
+    """One min-plus relaxation in [N, B] layout.
+
+    The batch axis is **minor**: ``dist_nb[nbr]`` gathers whole contiguous
+    ``[B]`` rows (one per (node, slot)), turning the relaxation's memory
+    traffic into streaming row reads instead of random scalar gathers — the
+    difference between HBM-bandwidth-bound and latency-bound on TPU.
+    """
+    # [N, K, B]: candidate cost through each out-slot
+    via = dg.w_pad[dg.out_eid][:, :, None] + dist_nb[dg.out_nbr, :]
+    via = jnp.minimum(via, JINF)
+    return jnp.minimum(dist_nb, via.min(axis=1))
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters",))
+def dist_to_targets(dg: DeviceGraph, targets: jnp.ndarray,
+                    max_iters: int = 0) -> jnp.ndarray:
+    """int32 [B, N] of d(x → targets[b]) for every node x.
+
+    ``targets`` int32 [B]; negative entries are padding rows (left all-INF
+    except their own source handling) so shard batches can be rectangular.
+    ``max_iters`` bounds the loop (0 = N-1, the Bellman-Ford worst case);
+    convergence exits early.
+    """
+    n = dg.n
+    b = targets.shape[0]
+    limit = (n - 1) if max_iters == 0 else max_iters
+    valid = targets >= 0
+    t_safe = jnp.where(valid, targets, 0)
+    dist0 = jnp.full((n, b), JINF, jnp.int32)
+    dist0 = dist0.at[t_safe, jnp.arange(b)].set(
+        jnp.where(valid, jnp.int32(0), JINF))
+
+    def cond(state):
+        i, dist, changed = state
+        return changed & (i < limit)
+
+    def body(state):
+        i, dist, _ = state
+        new = _relax_nb(dist, dg)
+        return i + 1, new, jnp.any(new < dist)
+
+    _, dist_nb, _ = jax.lax.while_loop(cond, body, (jnp.int32(0), dist0, True))
+    return dist_nb.T
+
+
+@jax.jit
+def first_move_from_dist(dg: DeviceGraph, targets: jnp.ndarray,
+                         dist: jnp.ndarray) -> jnp.ndarray:
+    """First-move table int8 [B, N] from converged distances.
+
+    ``fm[b, x]`` = out-edge slot of x minimizing ``w + d(nbr → targets[b])``
+    (first minimal slot on ties — ``jnp.argmin`` picks the first occurrence,
+    same rule as the CPU oracle). ``-1`` for unreachable, for the target row
+    itself, and for padding rows (targets[b] < 0).
+    """
+    # same [N, K, B] batch-minor layout as the relaxation (see _relax_nb)
+    via = dg.w_pad[dg.out_eid][:, :, None] + dist.T[dg.out_nbr, :]
+    via = jnp.minimum(via, JINF)
+    best = via.min(axis=1).T
+    fm = jnp.argmin(via, axis=1).astype(jnp.int8).T
+    fm = jnp.where(best >= JINF, jnp.int8(-1), fm)
+    # target's own row: no move
+    b = targets.shape[0]
+    n = dg.n
+    valid = targets >= 0
+    t_safe = jnp.where(valid, targets, 0)
+    at_target = jax.nn.one_hot(t_safe, n, dtype=jnp.bool_) & valid[:, None]
+    fm = jnp.where(at_target, jnp.int8(-1), fm)
+    fm = jnp.where(valid[:, None], fm, jnp.int8(-1))
+    return fm
+
+
+def build_fm_columns(dg: DeviceGraph, targets: jnp.ndarray,
+                     max_iters: int = 0) -> jnp.ndarray:
+    """CPD shard build: first-move columns for a batch of targets.
+
+    One fused device computation: Bellman-Ford to convergence, then
+    first-move extraction. Returns int8 [B, N].
+    """
+    dist = dist_to_targets(dg, targets, max_iters=max_iters)
+    return first_move_from_dist(dg, targets, dist)

@@ -1,0 +1,58 @@
+"""Device-resident graph arrays.
+
+The TPU-side graph representation: the padded ELL layout of
+``data.Graph`` as jnp arrays, ready for gather-based relaxation. Static
+shapes only — ``[N, K]`` neighbor/edge tables and a ``[M+1]`` weight vector
+whose last slot is INF so ELL padding lanes can never win a min (see
+``data.graph.Graph.ell``).
+
+This plays the role warthog's graph loader plays for the C++ engine
+(SURVEY.md §C5): everything downstream (CPD build, table-search) consumes
+only these arrays.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.graph import Graph, INF
+
+
+class DeviceGraph(NamedTuple):
+    """ELL graph on device.
+
+    out_nbr : int32 [N, K] — k-th out-neighbor (self for padding)
+    out_eid : int32 [N, K] — edge id (M for padding)
+    w_pad   : int32 [M+1]  — free-flow weights; w_pad[M] = INF
+    """
+    out_nbr: jnp.ndarray
+    out_eid: jnp.ndarray
+    w_pad: jnp.ndarray
+
+    @property
+    def n(self) -> int:
+        return self.out_nbr.shape[0]
+
+    @property
+    def k(self) -> int:
+        return self.out_nbr.shape[1]
+
+    @classmethod
+    def from_graph(cls, g: Graph, weights: np.ndarray | None = None
+                   ) -> "DeviceGraph":
+        nbr, eid = g.ell("out")
+        return cls(
+            out_nbr=jnp.asarray(nbr, jnp.int32),
+            out_eid=jnp.asarray(eid, jnp.int32),
+            w_pad=jnp.asarray(g.padded_weights(weights), jnp.int32),
+        )
+
+    def with_weights(self, w_pad: jnp.ndarray) -> "DeviceGraph":
+        """Same topology, different (e.g. congestion-perturbed) weights."""
+        return self._replace(w_pad=jnp.asarray(w_pad, jnp.int32))
+
+
+JINF = jnp.int32(INF)

@@ -1,0 +1,102 @@
+"""Batched ``table-search``: the query engine.
+
+TPU-native re-expression of the reference's resident query server, which
+answers each (s, t) by repeated first-move table lookups, accumulating cost
+on the possibly congestion-perturbed graph (``fifo_auto --alg table-search``,
+reference ``make_fifos.py:20-22``; hot loop in SURVEY.md §3.3). Instead of a
+per-query C++ loop over OpenMP threads, the whole query batch advances in
+lock-step: one ``lax.while_loop`` whose body gathers every active query's
+next hop at once — answering an entire scenario file in one XLA call
+(SURVEY.md §7 stage 4).
+
+Semantics (must match ``models.reference.table_search_walk``):
+
+* moves follow the **free-flow** first-move table; costs accumulate on the
+  **query-time** weights (diff applied to ``w_query_pad`` only),
+* a query finishes when it reaches its target; it stops unfinished on a
+  ``-1`` first move (unreachable) or when the move budget (``k_moves``,
+  reference ``args.py:31-36``) runs out,
+* ``plen`` = number of edges followed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .device_graph import DeviceGraph
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def table_search_batch(dg: DeviceGraph, fm: jnp.ndarray,
+                       t_rows: jnp.ndarray, s: jnp.ndarray, t: jnp.ndarray,
+                       w_query_pad: jnp.ndarray,
+                       valid: jnp.ndarray | None = None,
+                       k_moves: jnp.ndarray | int = -1,
+                       max_steps: int = 0):
+    """Answer a batch of queries against a first-move shard.
+
+    Parameters
+    ----------
+    fm          : int8 [R, N] first-move rows (R = targets owned by this shard)
+    t_rows      : int32 [Q] row index of each query's target within ``fm``
+    s, t        : int32 [Q] global source / target node ids
+    w_query_pad : int32 [M+1] query-time weights (diff applied; last = INF)
+    valid       : bool [Q] padding mask (False rows return zeros, unfinished)
+    k_moves     : per-batch move budget, -1 = unlimited (reference semantics)
+    max_steps   : loop bound; 0 = N (safe upper bound for simple paths)
+
+    Returns
+    -------
+    cost [Q] int32, plen [Q] int32, finished [Q] bool
+    """
+    q = s.shape[0]
+    n = dg.n
+    limit = n if max_steps == 0 else max_steps
+    budget = jnp.where(jnp.asarray(k_moves) < 0, jnp.int32(limit),
+                       jnp.asarray(k_moves).astype(jnp.int32))
+    if valid is None:
+        valid = jnp.ones((q,), jnp.bool_)
+
+    x0 = jnp.where(valid, s.astype(jnp.int32), t.astype(jnp.int32))
+    done0 = x0 == t.astype(jnp.int32)
+    # cost/plen start from x0 * 0 (not a fresh constant) so that, under
+    # shard_map, the carry inherits the inputs' mesh-varying type
+    state0 = (
+        jnp.int32(0),
+        x0,
+        x0 * 0,                       # cost
+        x0 * 0,                       # plen
+        done0,                        # reached target
+        done0,                        # halted (reached, stuck, or padding)
+    )
+    t32 = t.astype(jnp.int32)
+    rows32 = t_rows.astype(jnp.int32)
+
+    def cond(state):
+        i, _, _, _, _, halted = state
+        return (~jnp.all(halted)) & (i < limit)
+
+    def body(state):
+        i, x, cost, plen, finished, halted = state
+        # 2-D gather (row, col) rather than a flattened index: R * N can
+        # exceed int32 range on large sharded tables
+        slot = fm[rows32, x].astype(jnp.int32)
+        can_move = (~halted) & (slot >= 0) & (plen < budget)
+        slot_safe = jnp.maximum(slot, 0)
+        eid = dg.out_eid[x, slot_safe]
+        nxt = dg.out_nbr[x, slot_safe]
+        cost = jnp.where(can_move, cost + w_query_pad[eid], cost)
+        plen = jnp.where(can_move, plen + 1, plen)
+        x = jnp.where(can_move, nxt, x)
+        finished = finished | (x == t32)
+        halted = halted | finished | ~can_move
+        return i + 1, x, cost, plen, finished, halted
+
+    _, x, cost, plen, finished, _ = jax.lax.while_loop(cond, body, state0)
+    finished = finished & valid
+    cost = jnp.where(valid, cost, 0)
+    plen = jnp.where(valid, plen, 0)
+    return cost, plen, finished

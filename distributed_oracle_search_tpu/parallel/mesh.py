@@ -1,0 +1,54 @@
+"""Device-mesh construction and sharding specs.
+
+The TPU replacement for the reference's cluster topology: where the
+reference's ``workers`` list is ssh hostnames and its "communication
+backend" is ssh + tmux + NFS + named FIFOs (SURVEY.md §5), here a worker is
+a mesh shard and every exchange is an XLA collective over ICI/DCN inserted
+by GSPMD. One mesh axis — ``"worker"`` — carries the index sharding (the
+system's model-parallel axis: CPD rows live where their targets are owned);
+an optional leading ``"data"`` axis replicates the CPD and splits query
+batches (pure data parallelism) for meshes larger than the worker count.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "worker"
+DATA_AXIS = "data"
+
+
+def make_mesh(n_workers: int | None = None, n_data: int = 1,
+              devices=None) -> Mesh:
+    """Build a ``(data, worker)`` mesh.
+
+    ``n_workers`` defaults to all available devices (with ``n_data=1``).
+    Total devices used = ``n_data * n_workers``.
+    """
+    devices = jax.devices() if devices is None else devices
+    if n_workers is None:
+        n_workers = len(devices) // n_data
+    need = n_data * n_workers
+    if need > len(devices):
+        raise ValueError(
+            f"mesh ({n_data}x{n_workers}) needs {need} devices, "
+            f"have {len(devices)}")
+    dev = np.asarray(devices[:need]).reshape(n_data, n_workers)
+    return Mesh(dev, (DATA_AXIS, WORKER_AXIS))
+
+
+def worker_sharding(mesh: Mesh, rank: int = 1) -> NamedSharding:
+    """Shard axis 0 over workers, replicate everything else (CPD layout)."""
+    return NamedSharding(mesh, P(WORKER_AXIS, *([None] * (rank - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def query_sharding(mesh: Mesh, rank: int = 2) -> NamedSharding:
+    """Queries: [data, worker, ...] — batch split over data, routed rows on
+    the worker axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, *([None] * (rank - 2))))

@@ -1,0 +1,143 @@
+"""Sharded CPD build and sharded query execution.
+
+The two distributed phases of the system, on a device mesh:
+
+* **Build** (reference: per-worker ``make_cpd_auto`` processes launched over
+  ssh/tmux, SURVEY.md §3.1): every mesh shard computes first-move rows for
+  the targets it owns, in parallel, with zero cross-shard traffic — the
+  batch axis of the min-plus iteration is sharded over ``worker``, the graph
+  is replicated, and GSPMD keeps each row's computation on its row's device.
+  The only collective is the all-reduce of the convergence flag inside the
+  Bellman-Ford ``while_loop``.
+
+* **Query** (reference: per-worker FIFO round-trips driven by a head-node
+  thread pool, SURVEY.md §3.3): queries arrive pre-routed ``[D, W, Q]`` (row
+  w = queries whose target w owns, the invariant of
+  ``process_query.py:56-57``), an optional leading data axis splits the
+  batch, and each shard walks its own queries against its own fm rows via
+  ``shard_map`` — explicitly no resharding of the fm table.
+
+Compiled programs are cached at module level, keyed on (mesh, static
+shape knobs): a resident server calls these thousands of times, and an
+eagerly re-traced shard_map would pay a device round-trip per while_loop
+iteration — catastrophic over a remote-TPU link.
+
+Padding convention: rectangular arrays everywhere; targets pad with -1,
+queries pad with ``valid=False`` rows. Padding is computed-but-masked, the
+usual SPMD trade.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import DeviceGraph, build_fm_columns, table_search_batch
+from .mesh import WORKER_AXIS, DATA_AXIS, replicated
+
+
+def pad_targets(controller, dtype=np.int32) -> np.ndarray:
+    """[W, R] owned targets per worker, -1-padded to the max shard size."""
+    w = controller.maxworker
+    r = max(controller.max_owned, 1)
+    out = np.full((w, r), -1, dtype)
+    for wid in range(w):
+        owned = controller.owned(wid)
+        out[wid, :len(owned)] = owned
+    return out
+
+
+# --------------------------------------------------------------------- build
+
+@functools.lru_cache(maxsize=None)
+def _build_fn(mesh: Mesh, n_workers: int, max_iters: int):
+    tgt_shard = NamedSharding(mesh, P(None, WORKER_AXIS))
+    out_shard = NamedSharding(mesh, P(WORKER_AXIS, None, None))
+
+    @functools.partial(jax.jit, in_shardings=(replicated(mesh), tgt_shard),
+                       out_shardings=out_shard)
+    def _build(dg, tgt_bw):
+        # tgt_bw: [B, W] — worker on the minor axis so each device owns a
+        # column; transpose+flatten into the row-sharded batch
+        fm = build_fm_columns(dg, tgt_bw.T.reshape(-1), max_iters=max_iters)
+        return fm.reshape(n_workers, -1, dg.n)
+
+    return _build
+
+
+def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
+                     mesh: Mesh, chunk: int = 0,
+                     max_iters: int = 0) -> jax.Array:
+    """Build the full sharded CPD: int8 [W, R, N], axis 0 on ``worker``.
+
+    ``chunk`` bounds per-device live distance rows (0 = whole shard at
+    once): the host loops over column-chunks of ``targets_wr`` so each
+    device only ever materializes ``[chunk, N]`` int32 distances, then
+    concatenates the int8 results — the memory staging the reference gets
+    from per-block CPD files (``README.md:92``).
+    """
+    w, r = targets_wr.shape
+    if mesh.shape[WORKER_AXIS] != w:
+        raise ValueError(
+            f"targets rows ({w}) != mesh worker axis "
+            f"({mesh.shape[WORKER_AXIS]})")
+    build = _build_fn(mesh, w, max_iters)
+    if chunk <= 0 or chunk >= r:
+        chunks = [targets_wr]
+    else:
+        # equal chunk sizes (pad the target list) so every chunk hits the
+        # same compiled program
+        pad = (-r) % chunk
+        if pad:
+            targets_wr = np.concatenate(
+                [targets_wr, np.full((w, pad), -1, targets_wr.dtype)], axis=1)
+        chunks = [targets_wr[:, i:i + chunk]
+                  for i in range(0, targets_wr.shape[1], chunk)]
+    parts = [build(dg, jnp.asarray(c.T)) for c in chunks]
+    fm = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+    return fm[:, :r]
+
+
+# --------------------------------------------------------------------- query
+
+@functools.lru_cache(maxsize=None)
+def _query_fn(mesh: Mesh, max_steps: int):
+    q3 = P(DATA_AXIS, WORKER_AXIS, None)
+
+    def _local(dg, fm_local, rows, s, t, valid, w_pad, k_moves):
+        # local blocks: fm [1, R, N]; queries [D/|data|, 1, Q]
+        fm2 = fm_local[0]
+        shape = s.shape
+        cost, plen, fin = table_search_batch(
+            dg, fm2, rows.reshape(-1), s.reshape(-1), t.reshape(-1), w_pad,
+            valid=valid.reshape(-1), k_moves=k_moves, max_steps=max_steps)
+        return (cost.reshape(shape), plen.reshape(shape), fin.reshape(shape))
+
+    sm = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, P(), P()),
+        out_specs=(q3, q3, q3),
+    )
+    return jax.jit(sm)
+
+
+def query_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
+                  t_rows: np.ndarray, s: np.ndarray, t: np.ndarray,
+                  valid: np.ndarray, w_query_pad, mesh: Mesh,
+                  k_moves: int = -1, max_steps: int = 0):
+    """Answer routed query batches on the mesh.
+
+    Inputs are ``[D, W, Q]`` (data axis × worker axis × padded queries):
+    ``t_rows`` = local fm row of each query's target, ``valid`` masks
+    padding. Returns ``(cost, plen, finished)`` each ``[D, W, Q]``.
+    """
+    qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
+    args = [jax.device_put(jnp.asarray(a), qs)
+            for a in (t_rows, s, t, valid)]
+    fn = _query_fn(mesh, max_steps)
+    return fn(dg, fm_wrn, *args, jnp.asarray(w_query_pad),
+              jnp.int32(k_moves))

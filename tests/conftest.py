@@ -8,11 +8,22 @@ imports jax.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the host environment pins jax to the real TPU (axon
+# platform, forced by a sitecustomize hook that calls
+# jax.config.update("jax_platforms", ...) at interpreter start, trumping the
+# JAX_PLATFORMS env var). Re-override via jax.config before any backend
+# initializes; tests always run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+assert len(jax.devices()) == 8, jax.devices()
 
 import numpy as np
 import pytest

@@ -1,0 +1,132 @@
+"""CPDOracle on an 8-virtual-device mesh: sharded build == CPU oracle,
+routed queries in input order, save/load round-trip, partition-mismatch
+guard."""
+
+import numpy as np
+import pytest
+import jax
+
+from distributed_oracle_search_tpu.data import synth_diff
+from distributed_oracle_search_tpu.models import first_move_matrix, dist_to_target
+from distributed_oracle_search_tpu.models.cpd import CPDOracle
+from distributed_oracle_search_tpu.parallel import DistributionController
+from distributed_oracle_search_tpu.parallel.mesh import make_mesh, WORKER_AXIS
+
+
+@pytest.fixture(scope="module", params=["tpu", "mod"])
+def oracle(request, toy_graph):
+    dc = DistributionController(request.param,
+                                8 if request.param == "mod" else None,
+                                8, toy_graph.n, block_size=4)
+    return CPDOracle(toy_graph, dc).build(chunk=3)
+
+
+def test_sharded_build_matches_cpu_oracle(toy_graph, oracle):
+    fm = np.asarray(oracle.fm)
+    dc = oracle.dc
+    for wid in range(dc.maxworker):
+        owned = dc.owned(wid)
+        golden = first_move_matrix(toy_graph, owned)
+        np.testing.assert_array_equal(fm[wid, :len(owned)], golden,
+                                      err_msg=f"worker {wid}")
+        # padding rows all -1
+        assert np.all(fm[wid, len(owned):] == -1)
+
+
+def test_fm_is_sharded_over_workers(oracle):
+    shard_devices = {d for s in oracle.fm.addressable_shards
+                     for d in [s.device]}
+    assert len(shard_devices) == 8
+    # each shard holds exactly its row slice
+    for s in oracle.fm.addressable_shards:
+        assert s.data.shape[0] == 1
+
+
+def test_query_input_order_and_correctness(toy_graph, oracle, toy_queries):
+    cost, plen, fin = oracle.query(toy_queries)
+    assert fin.all()
+    for i, (s, t) in enumerate(toy_queries):
+        assert cost[i] == dist_to_target(toy_graph, int(t))[s], (s, t)
+
+
+def test_query_with_diff_and_kmoves(toy_graph, oracle, toy_queries):
+    w_query = toy_graph.weights_with_diff(synth_diff(toy_graph, 0.3, seed=21))
+    c0, p0, f0 = oracle.query(toy_queries)
+    c1, p1, f1 = oracle.query(toy_queries, w_query=w_query)
+    np.testing.assert_array_equal(p0, p1)
+    assert np.all(c1 >= c0)
+    c2, p2, f2 = oracle.query(toy_queries, k_moves=1)
+    assert np.all(p2 <= 1)
+
+
+def test_active_worker_filter(toy_graph, oracle, toy_queries):
+    dc = oracle.dc
+    wid = 3
+    cost_all, _, fin_all = oracle.query(toy_queries)
+    cost_w, _, fin_w = oracle.query(toy_queries, active_worker=wid)
+    mine = dc.worker_of(toy_queries[:, 1]) == wid
+    np.testing.assert_array_equal(cost_w[mine], cost_all[mine])
+    assert fin_w[mine].all()
+    assert not fin_w[~mine].any()
+    assert np.all(cost_w[~mine] == 0)
+
+
+def test_save_load_roundtrip(tmp_path, toy_graph, oracle, toy_queries):
+    outdir = str(tmp_path / "index")
+    oracle.save(outdir)
+    import os
+    import json
+    with open(os.path.join(outdir, "index.json")) as f:
+        manifest = json.load(f)
+    # block files per worker: ceil(owned / block_size)
+    dc = oracle.dc
+    expect = sum(-(-dc.n_owned(w) // dc.block_size)
+                 for w in range(dc.maxworker))
+    assert len(manifest["files"]) == expect
+
+    fresh = CPDOracle(toy_graph, dc).load(outdir)
+    np.testing.assert_array_equal(np.asarray(fresh.fm),
+                                  np.asarray(oracle.fm))
+    c0, _, f0 = oracle.query(toy_queries)
+    c1, _, f1 = fresh.query(toy_queries)
+    np.testing.assert_array_equal(c0, c1)
+
+
+def test_load_rejects_mismatched_partition(tmp_path, toy_graph, oracle):
+    outdir = str(tmp_path / "index2")
+    oracle.save(outdir)
+    other = DistributionController("div", -(-toy_graph.n // 8), 8,
+                                   toy_graph.n, block_size=4)
+    with pytest.raises(ValueError, match="partmethod"):
+        CPDOracle(toy_graph, other).load(outdir)
+
+
+def test_load_rejects_same_method_different_partkey(tmp_path, toy_graph):
+    # same partmethod, different partkey must be refused: rows would land
+    # under the wrong owners and queries would silently go wrong
+    dc6 = DistributionController("div", 7, 8, toy_graph.n, block_size=4)
+    o = CPDOracle(toy_graph, dc6).build()
+    outdir = str(tmp_path / "index3")
+    o.save(outdir)
+    dc7 = DistributionController("div", 8, 8, toy_graph.n, block_size=4)
+    with pytest.raises(ValueError, match="partkey"):
+        CPDOracle(toy_graph, dc7).load(outdir)
+
+
+def test_mesh_worker_mismatch_rejected(toy_graph):
+    dc = DistributionController("mod", 3, 3, toy_graph.n)
+    mesh = make_mesh(n_workers=8)
+    with pytest.raises(ValueError, match="worker axis"):
+        CPDOracle(toy_graph, dc, mesh=mesh)
+
+
+def test_data_axis_mesh(toy_graph, toy_queries):
+    # 2x4 mesh: data parallelism over query batches x worker sharding
+    dc = DistributionController("tpu", None, 4, toy_graph.n)
+    mesh = make_mesh(n_workers=4, n_data=2)
+    o = CPDOracle(toy_graph, dc, mesh=mesh).build()
+    cost, plen, fin = o.query(toy_queries)
+    assert fin.all()
+    for i in range(0, len(toy_queries), 9):
+        s, t = map(int, toy_queries[i])
+        assert cost[i] == dist_to_target(toy_graph, t)[s]

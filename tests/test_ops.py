@@ -1,0 +1,133 @@
+"""TPU ops vs CPU oracle: golden equality on distances, first moves, walks."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from distributed_oracle_search_tpu.data import synth_diff
+from distributed_oracle_search_tpu.data.graph import Graph, INF
+from distributed_oracle_search_tpu.models import (
+    dist_to_target, first_move_matrix, table_search_walk,
+)
+from distributed_oracle_search_tpu.ops import (
+    DeviceGraph, dist_to_targets, first_move_from_dist, build_fm_columns,
+    table_search_batch,
+)
+
+
+@pytest.fixture(scope="module")
+def dg(toy_graph):
+    return DeviceGraph.from_graph(toy_graph)
+
+
+def test_dist_matches_dijkstra(toy_graph, dg):
+    g = toy_graph
+    targets = np.array([0, 5, g.n // 2, g.n - 1], np.int32)
+    dist = np.asarray(dist_to_targets(dg, jnp.asarray(targets)))
+    for b, t in enumerate(targets):
+        golden = dist_to_target(g, int(t))
+        np.testing.assert_array_equal(dist[b], golden)
+
+
+def test_first_move_matches_oracle_exactly(toy_graph, dg):
+    # Equality includes tie-breaking: both sides take the first minimal slot.
+    g = toy_graph
+    targets = np.arange(g.n, dtype=np.int32)
+    fm_tpu = np.asarray(build_fm_columns(dg, jnp.asarray(targets)))
+    fm_cpu = first_move_matrix(g, targets)
+    np.testing.assert_array_equal(fm_tpu, fm_cpu)
+
+
+def test_padding_rows_are_inert(toy_graph, dg):
+    targets = jnp.asarray([3, -1, 7, -1], jnp.int32)
+    dist = dist_to_targets(dg, targets)
+    fm = first_move_from_dist(dg, targets, dist)
+    assert np.all(np.asarray(dist)[1] == INF)
+    assert np.all(np.asarray(fm)[1] == -1)
+    assert np.all(np.asarray(fm)[3] == -1)
+    # real rows unaffected by the padding rows
+    np.testing.assert_array_equal(
+        np.asarray(fm)[0], first_move_matrix(toy_graph, np.array([3]))[0])
+
+
+def test_batch_walk_matches_reference_walk(toy_graph, dg, toy_queries):
+    g = toy_graph
+    targets = np.arange(g.n, dtype=np.int32)
+    fm = build_fm_columns(dg, jnp.asarray(targets))
+
+    s = toy_queries[:, 0].astype(np.int32)
+    t = toy_queries[:, 1].astype(np.int32)
+    cost, plen, fin = table_search_batch(
+        dg, fm, jnp.asarray(t), jnp.asarray(s), jnp.asarray(t), dg.w_pad)
+    cost, plen, fin = map(np.asarray, (cost, plen, fin))
+
+    fm_np = np.asarray(fm)
+    for i, (si, ti) in enumerate(toy_queries):
+        c, p, f, _ = table_search_walk(
+            g, lambda x, tt: fm_np[tt, x], int(si), int(ti))
+        assert (cost[i], plen[i], fin[i]) == (c, p, f), f"query {si}->{ti}"
+        # and the walk cost is the true shortest distance
+        assert cost[i] == dist_to_target(g, int(ti))[si]
+
+
+def test_batch_walk_with_diff(toy_graph, dg, toy_queries):
+    g = toy_graph
+    w_query = g.weights_with_diff(synth_diff(g, frac=0.3, seed=13))
+    w_query_pad = jnp.asarray(g.padded_weights(w_query))
+    targets = np.arange(g.n, dtype=np.int32)
+    fm = build_fm_columns(dg, jnp.asarray(targets))
+    s = jnp.asarray(toy_queries[:, 0], jnp.int32)
+    t = jnp.asarray(toy_queries[:, 1], jnp.int32)
+
+    c_free, p_free, f_free = table_search_batch(dg, fm, t, s, t, dg.w_pad)
+    c_diff, p_diff, f_diff = table_search_batch(dg, fm, t, s, t, w_query_pad)
+    # same routes (free-flow first moves), higher-or-equal cost, same plen
+    np.testing.assert_array_equal(np.asarray(p_free), np.asarray(p_diff))
+    np.testing.assert_array_equal(np.asarray(f_free), np.asarray(f_diff))
+    assert np.all(np.asarray(c_diff) >= np.asarray(c_free))
+
+    fm_np = np.asarray(fm)
+    for i in range(0, len(toy_queries), 7):
+        si, ti = map(int, toy_queries[i])
+        c, p, f, _ = table_search_walk(
+            g, lambda x, tt: fm_np[tt, x], si, ti, w_query=w_query)
+        assert np.asarray(c_diff)[i] == c
+
+
+def test_k_moves_budget(toy_graph, dg, toy_queries):
+    targets = np.arange(toy_graph.n, dtype=np.int32)
+    fm = build_fm_columns(dg, jnp.asarray(targets))
+    s = jnp.asarray(toy_queries[:, 0], jnp.int32)
+    t = jnp.asarray(toy_queries[:, 1], jnp.int32)
+    _, plen_all, fin_all = table_search_batch(dg, fm, t, s, t, dg.w_pad)
+    _, plen2, fin2 = table_search_batch(dg, fm, t, s, t, dg.w_pad, k_moves=2)
+    plen_all, fin_all, plen2, fin2 = map(
+        np.asarray, (plen_all, fin_all, plen2, fin2))
+    assert np.all(plen2 <= 2)
+    long_ones = plen_all > 2
+    assert not np.any(fin2[long_ones])
+    short_ones = (plen_all <= 2) & fin_all
+    np.testing.assert_array_equal(fin2[short_ones],
+                                  np.ones(short_ones.sum(), bool))
+
+
+def test_valid_mask_padding(toy_graph, dg):
+    targets = np.arange(toy_graph.n, dtype=np.int32)
+    fm = build_fm_columns(dg, jnp.asarray(targets))
+    s = jnp.asarray([1, 0, 2], jnp.int32)
+    t = jnp.asarray([5, 0, 9], jnp.int32)
+    valid = jnp.asarray([True, False, True])
+    cost, plen, fin = table_search_batch(dg, fm, t, s, t, dg.w_pad, valid=valid)
+    assert not np.asarray(fin)[1] and np.asarray(cost)[1] == 0
+    assert np.asarray(fin)[0] and np.asarray(fin)[2]
+
+
+def test_unreachable_batch():
+    g = Graph(xs=[0, 1, 5, 6], ys=[0, 0, 0, 0],
+              src=[0, 1, 2, 3], dst=[1, 0, 3, 2], w=[1, 1, 1, 1])
+    dg = DeviceGraph.from_graph(g)
+    fm = build_fm_columns(dg, jnp.asarray([3], jnp.int32))
+    cost, plen, fin = table_search_batch(
+        dg, fm, jnp.asarray([0]), jnp.asarray([0]), jnp.asarray([3]),
+        dg.w_pad)
+    assert not np.asarray(fin)[0] and np.asarray(plen)[0] == 0
