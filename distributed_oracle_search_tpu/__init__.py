@@ -20,7 +20,8 @@ Package layout:
 ``parallel/``  partitioning (DistributionController) and device-mesh sharding
 ``ops/``       JAX compute kernels (Bellman-Ford, first-move, table-search)
 ``models/``    oracle model families (CPD oracle, CPU reference oracles)
-``runtime/``   resident servers, wire protocol, cluster launch
+``transport/`` wire protocol, FIFO transport, ssh/tmux job launch
+``worker/``    worker-resident shard engine, FIFO server, shard builder
 ``cli/``       drivers mirroring the reference entry points
 ``utils/``     timers, config, logging
 """

@@ -1,0 +1,12 @@
+"""Head-node drivers: CLI parity with the reference's entry points.
+
+* ``make_cpds``      — distributed CPD precompute (reference P2)
+* ``make_fifos``     — resident query-server launch (reference P3)
+* ``process_query``  — the query campaign (reference P4)
+* ``offline``        — single-machine legacy driver (reference P6)
+* ``args``           — the shared flag surface (reference P1)
+"""
+
+from .args import build_parser, get_time_ns, parse_args, process_filename
+
+__all__ = ["build_parser", "get_time_ns", "parse_args", "process_filename"]

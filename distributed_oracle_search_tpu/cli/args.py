@@ -1,0 +1,155 @@
+"""Shared CLI parser for all drivers.
+
+Flag-surface parity with the reference's shared argparse namespace
+(reference ``args.py:1-188``), minus its dead flags: the Spark interface /
+broadcast / streaming / server groups are vestiges of an architecture the
+reference itself migrated off (reference ``offline.py:1-3``, SURVEY.md §5
+"config") and are deliberately not reproduced.
+
+Differences from the reference:
+
+* built as a function returning a fresh parser (the reference parses at
+  import time into a module global, ``args.py:188`` — untestable);
+* ``parse_known_args`` pass-through is preserved via ``parse_args(argv)``
+  wrapper below;
+* new ``--backend {tpu,host}`` override and ``--profile`` (jax.profiler
+  trace dir).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog, conflict_handler="resolve")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    p.add_argument("-t", "--test", action="store_true",
+                   help="Run the canned smoke-test config.")
+    p.add_argument("-c", type=str, default="./example-cluster-conf.json",
+                   help="Cluster config JSON.")
+    p.add_argument("-D", "--debug", action="store_true",
+                   help="Deterministic single-threaded repro mode.")
+    p.add_argument("-w", "--worker", type=int, default=-1,
+                   help="Restrict the run to one worker id.")
+
+    part = p.add_argument_group("partitioning")
+    part.add_argument("-p", "--num-partitions", type=int, default=0,
+                      help="Number of partitions (0 = one per worker).")
+    part.add_argument("-s", "--size-partitions", type=int, default=0,
+                      help="Target partition size (overrides -p).")
+    part.add_argument("--group", type=str,
+                      choices=["all", "mod", "div"],
+                      help="Partition generation scheme; default is by "
+                           "range.")
+    part.add_argument("--sort", action="store_true",
+                      help="Sort partitions on targets before sending.")
+    modus = part.add_mutually_exclusive_group()
+    modus.add_argument("--div", type=int,
+                       help="Assign nodes to worker = target / div.")
+    modus.add_argument("--mod", type=int,
+                       help="Assign nodes to worker = target %% mod.")
+    modus.add_argument("--alloc", type=int, nargs="+",
+                       help="Ascending range bounds, one per worker.")
+
+    path = p.add_argument_group("search")
+    path.add_argument("-k", "--k-moves", type=int, default=-1,
+                      help="Number of moves to extract; -1 = all.")
+    path.add_argument("--h-scale", default=1.0, type=float,
+                      help="Heuristic tolerance factor for A*.")
+    path.add_argument("--f-scale", default=0.0, type=float,
+                      help="Sub-optimality factor for A*.")
+    path.add_argument("--itrs", default=1, type=int,
+                      help="Search iterations per batch.")
+    path.add_argument("--s-lim", default=0, type=int,
+                      help="Time limit in seconds.")
+    path.add_argument("--ms-lim", default=0, type=int,
+                      help="Time limit in milliseconds.")
+    path.add_argument("--us-lim", default=0, type=int,
+                      help="Time limit in microseconds.")
+    path.add_argument("--ns-lim", default=0, type=int,
+                      help="Time limit in nanoseconds.")
+
+    batch = p.add_argument_group("batching")
+    batch.add_argument("-o", "--output",
+                       help="Directory to write campaign artifacts to.")
+    batch.add_argument("--omp", type=int, default=0,
+                       help="Worker thread count (wire parity; XLA SPMD "
+                            "makes this a no-op on device).")
+
+    files = p.add_argument_group("files")
+    files.add_argument("-b", "--base", type=str, default=".",
+                       help="Base directory the code is run from.")
+    files.add_argument("-d", "--dir", type=str, default="data",
+                       help="Directory containing map/scenario files.")
+    files.add_argument("-m", "--map", type=str, default="",
+                       help="Graph (.xy) to use.")
+    files.add_argument("--scenario", type=str, default="",
+                       help="Scenario file to read from.")
+    files.add_argument("--diff", type=str,
+                       help="Travel-time diff file for the search.")
+
+    rand = p.add_argument_group("random")
+    rand.add_argument("-R", "--random", action="store_true",
+                      help="Randomise the seed.")
+    rand.add_argument("--seed", type=int, default=562410645)
+
+    fifo = p.add_argument_group("fifo")
+    fifo.add_argument("--fifo", type=str, default="/tmp/warthog.fifo",
+                      help="Command FIFO path (offline/local mode).")
+    fifo.add_argument("--local", action="store_true",
+                      help="Force the local no-ssh path.")
+    fifo.add_argument("--cutoff", type=int, default=0,
+                      help="Below this many queries, run locally.")
+    fifo.add_argument("--thread-alloc", type=int, default=0,
+                      help="Receiver-thread pinning (wire parity no-op).")
+    fifo.add_argument("--nfs", type=str, default="/tmp",
+                      help="Shared directory for query files.")
+    fifo.add_argument("--diffs", type=str, nargs="+", default=["-"],
+                      help="Diff files for congestion; '-' = free flow.")
+    fifo.add_argument("--no-cache", action="store_true",
+                      help="Disable the workers' runtime cache.")
+
+    new = p.add_argument_group("tpu (new in this framework)")
+    new.add_argument("--backend", choices=["auto", "tpu", "host"],
+                     default="auto",
+                     help="Execution backend; auto follows the cluster "
+                          "conf's partmethod.")
+    new.add_argument("--profile", type=str, default="",
+                     help="Write a jax.profiler trace to this directory.")
+    new.add_argument("--chunk", type=int, default=0,
+                     help="CPD build: target rows per build step "
+                          "(0 = all owned rows at once).")
+    return p
+
+
+def parse_args(argv=None, prog: str | None = None) -> argparse.Namespace:
+    """Parse, tolerating unknown flags (parity with the reference's
+    ``parse_known_args`` pass-through, ``args.py:188``)."""
+    args, _unknown = build_parser(prog).parse_known_args(argv)
+    return args
+
+
+def get_time_ns(args) -> int:
+    """Resolve the ``--s/ms/us/ns-lim`` family to one ns budget (parity:
+    reference ``args.py:210-221``)."""
+    tlim = args.ns_lim
+    if args.s_lim > 0:
+        tlim = int(args.s_lim * 1e9)
+    elif args.ms_lim > 0:
+        tlim = int(args.ms_lim * 1e6)
+    elif args.us_lim > 0:
+        tlim = int(args.us_lim * 1e3)
+    return tlim
+
+
+def process_filename(fname: str, base: str = ".", dirname: str = "") -> str:
+    """Resolve a data filename directly or under ``base/dir`` (parity:
+    reference ``args.py:198-207``)."""
+    if os.path.isfile(fname):
+        return fname
+    with_dir = os.path.join(base, dirname, fname)
+    if os.path.isfile(with_dir):
+        return with_dir
+    raise IOError(f"File {fname} not found, searched {with_dir}.")

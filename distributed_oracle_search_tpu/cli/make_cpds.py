@@ -1,0 +1,118 @@
+"""Distributed CPD precompute launcher: the framework's ``make_cpds.py``.
+
+Role parity with reference P2 (SURVEY.md §2.1): read the cluster conf, then
+for each worker start the per-worker CPD build.
+
+* ``partmethod=tpu`` (the north-star path): no ssh at all — one in-process
+  sharded build over the device mesh (every mesh shard builds its rows in
+  parallel, SURVEY.md §2.3 "build parallelism"), then the index is saved to
+  ``outdir`` with its manifest.
+* host partmethods (``div``/``mod``/``alloc``): launch one
+  ``worker.build`` process per worker — ssh + detached tmux for remote
+  hosts (the reference's mechanism, ``make_cpds.py:21``), tracked local
+  subprocesses for localhost. Unlike the reference's fire-and-forget
+  (SURVEY.md §3.1 "no completion signal"), local builds are awaited and the
+  index manifest is written when all shards are present.
+
+``-t`` runs the canned smoke config; ``-w N`` restricts to one worker
+(reference ``make_cpds.py:27-41,58-62``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .args import parse_args
+from ..transport.launch import launch, session_name
+from ..utils.config import ClusterConfig, test_config
+from ..utils.log import get_logger, set_verbosity
+
+log = get_logger(__name__)
+
+
+def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0) -> str:
+    """The shell command a host-mode worker runs (our ``make_cpd_auto``)."""
+    partkey = (" ".join(str(b) for b in conf.partkey)
+               if isinstance(conf.partkey, (list, tuple))
+               else str(conf.partkey))
+    cmd = (f"{sys.executable} -m distributed_oracle_search_tpu.worker.build"
+           f" --input {conf.xy_file} --partmethod {conf.partmethod}"
+           f" --partkey {partkey} --workerid {wid}"
+           f" --maxworker {conf.maxworker} --outdir {conf.outdir}")
+    if chunk:
+        cmd += f" --chunk {chunk}"
+    return cmd
+
+
+def call_worker(wid: int, conf: ClusterConfig, chunk: int = 0):
+    """Launch one worker's build (parity: reference ``make_cpds.py:10-25``).
+
+    Returns a Popen handle when the build runs as a tracked local
+    subprocess, else None (tmux/ssh detached)."""
+    host = conf.workers[wid]
+    cmd = worker_build_cmd(wid, conf, chunk)
+    log.info("launch build w%d on %s: %s", wid, host, cmd)
+    # prefer_track: builds are finite jobs — await local ones so the index
+    # manifest can be finalized when they all complete
+    return launch(host, session_name("worker", wid), cmd,
+                  projectdir=conf.projectdir, prefer_track=True)
+
+
+def run_tpu(conf: ClusterConfig, args) -> None:
+    """In-process sharded build over the mesh."""
+    from ..data.graph import Graph
+    from ..models.cpd import CPDOracle
+    from ..parallel.mesh import make_mesh
+    from ..parallel.partition import DistributionController
+
+    graph = Graph.from_xy(conf.xy_file)
+    dc = DistributionController(conf.partmethod, conf.partkey,
+                                conf.maxworker, graph.n)
+    mesh = make_mesh(n_workers=conf.maxworker)
+    oracle = CPDOracle(graph, dc, mesh=mesh)
+    oracle.build(chunk=args.chunk)
+    oracle.save(conf.outdir)
+    print(f"built sharded CPD for {graph.n} nodes over "
+          f"{conf.maxworker} mesh shards -> {conf.outdir}")
+
+
+def run_host(conf: ClusterConfig, args) -> None:
+    procs = []
+    for wid in range(conf.maxworker):
+        if args.worker != -1 and wid != args.worker:
+            continue
+        proc = call_worker(wid, conf, chunk=args.chunk)
+        if proc is not None:
+            procs.append((wid, proc))
+    failures = 0
+    for wid, proc in procs:
+        if proc.wait() != 0:
+            log.error("worker %d build failed (rc=%d)", wid, proc.returncode)
+            failures += 1
+    if procs and not failures and args.worker == -1:
+        # all local builds done -> finalize the index manifest
+        from ..data.formats import xy_node_count
+        from ..models.cpd import write_index_manifest
+        from ..parallel.partition import DistributionController
+        dc = DistributionController(conf.partmethod, conf.partkey,
+                                    conf.maxworker,
+                                    xy_node_count(conf.xy_file))
+        write_index_manifest(conf.outdir, dc)
+        print(f"index complete -> {conf.outdir}")
+    if failures:
+        raise SystemExit(f"{failures} worker build(s) failed")
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv, prog="make_cpds")
+    set_verbosity(args.verbose)
+    conf = test_config() if args.test else ClusterConfig.load(args.c)
+    if args.backend == "tpu" or (args.backend == "auto" and conf.is_tpu):
+        run_tpu(conf, args)
+    else:
+        run_host(conf, args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

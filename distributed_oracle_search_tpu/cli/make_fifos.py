@@ -1,0 +1,79 @@
+"""Resident query-server launcher: the framework's ``make_fifos.py``.
+
+Role parity with reference P3 (SURVEY.md §2.1): for each worker, start a
+resident query server that loads the graph, the first diff, and its CPD
+shard, then blocks on its command FIFO ``/tmp/worker<wid>.fifo``.
+
+* host partmethods: one ``worker.server`` process per worker — ssh +
+  detached tmux for remote hosts (reference ``make_fifos.py:22``), tracked
+  local subprocess otherwise. Session name ``fifo-<wid>``.
+* ``partmethod=tpu``: servers are unnecessary — the campaign driver
+  (``cli.process_query``) answers in-process on the mesh; this launcher
+  says so and exits 0 (launch host-mode servers with ``--backend host`` if
+  you want FIFO transport against CPU shards anyway).
+
+The algorithm is table-search, as in the reference (hard-coded at
+``make_fifos.py:20``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .args import parse_args
+from ..transport.launch import launch, session_name
+from ..utils.config import ClusterConfig, test_config
+from ..utils.log import get_logger, set_verbosity
+
+log = get_logger(__name__)
+
+
+def worker_server_cmd(wid: int, conf_path: str, verbose: int = 0) -> str:
+    cmd = (f"{sys.executable} -m distributed_oracle_search_tpu.worker.server"
+           f" -c {conf_path} --workerid {wid}")
+    if verbose:
+        cmd += " -" + "v" * verbose
+    return cmd
+
+
+def call_worker(wid: int, conf: ClusterConfig, conf_path: str,
+                verbose: int = 0):
+    host = conf.workers[wid]
+    cmd = worker_server_cmd(wid, conf_path, verbose)
+    log.info("launch server w%d on %s: %s", wid, host, cmd)
+    return launch(host, session_name("fifo", wid), cmd,
+                  projectdir=conf.projectdir)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv, prog="make_fifos")
+    set_verbosity(args.verbose)
+    if args.test:
+        conf, conf_path = test_config(), None
+    else:
+        conf, conf_path = ClusterConfig.load(args.c), args.c
+    if args.backend != "host" and conf.is_tpu:
+        print("partmethod=tpu: queries run in-process on the device mesh; "
+              "no resident servers needed. (Use --backend host to force "
+              "FIFO servers.)")
+        return 0
+    if conf_path is None:
+        raise SystemExit("host-mode servers need a conf file (-c), "
+                         "not -t test mode")
+    procs = []
+    for wid in range(conf.maxworker):
+        if args.worker != -1 and wid != args.worker:
+            continue
+        proc = call_worker(wid, conf, conf_path, args.verbose)
+        if proc is not None:
+            procs.append((wid, proc))
+    print(f"launched {conf.maxworker if args.worker == -1 else 1} "
+          f"query server(s)")
+    # tracked local subprocesses are intentionally NOT awaited: servers are
+    # resident. Handles returned for embedders/tests via module state.
+    main.procs = procs  # type: ignore[attr-defined]
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,264 @@
+"""Query campaign driver: the framework's ``process_query.py``.
+
+Role parity with reference P4 (SURVEY.md §2.1, call stack §3.3): read the
+scenario, partition queries by the worker owning each **target** node, run
+one round per congestion diff, collect per-worker stats rows, and emit the
+campaign artifacts.
+
+Two backends behind one stats schema:
+
+* ``partmethod=tpu`` — the north-star path: the CPD lives sharded on a
+  device mesh; each diff round is answered by ONE sharded XLA call
+  (``CPDOracle.query``) instead of N FIFO round-trips. Per-worker stats
+  rows are recovered from the routed results, so downstream tooling sees
+  the same ``parts.csv`` either way.
+* host mode — the reference mechanism, modernized: query files to the
+  shared dir, 2-line config through each worker's command FIFO, one CSV
+  stats line back (``transport``), driven concurrently by a thread pool
+  (reference ``process_query.py:180-185``), with explicit failure rows and
+  retries instead of garbage rows (SURVEY.md §2.1 quirks).
+
+Artifacts (``-o DIR``): ``metrics.json`` (phase timings), ``data.json``
+(full arg dump), ``parts.csv`` (per-worker rows) — reference
+``process_query.py:230-239``, with its multi-worker CSV crash fixed (the
+reference's ``[[i] + row for i, row in stats]`` mis-unpacks, SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+
+import numpy as np
+
+from .args import get_time_ns, parse_args
+from ..data.formats import read_diff, read_scen, xy_node_count
+from ..parallel.partition import DistributionController
+from ..transport.fifo import answer_fifo_path, command_fifo_path, fan_out
+from ..transport.wire import (
+    Request, RuntimeConfig, STATS_HEADER, StatsRow, write_query_file,
+)
+from ..transport import fifo as fifo_transport
+from ..utils.config import ClusterConfig, test_config
+from ..utils.log import get_logger, set_verbosity
+from ..utils.timer import Timer
+
+log = get_logger(__name__)
+
+
+def runtime_config(args) -> RuntimeConfig:
+    """Per-batch engine knobs from CLI args (parity: reference
+    ``process_query.py:149-160``)."""
+    return RuntimeConfig(
+        hscale=args.h_scale, fscale=args.f_scale, time=get_time_ns(args),
+        itrs=args.itrs, k_moves=args.k_moves, threads=args.omp,
+        verbose=args.verbose, debug=args.debug,
+        thread_alloc=args.thread_alloc, no_cache=args.no_cache,
+    )
+
+
+def effective_partition(conf: ClusterConfig, args):
+    """CLI ``--div/--mod/--alloc`` override the conf's partmethod (the
+    reference's modus group, ``args.py:175-183``)."""
+    if args.div is not None:
+        return "div", args.div
+    if args.mod is not None:
+        return "mod", args.mod
+    if args.alloc is not None:
+        return "alloc", list(args.alloc)
+    return conf.partmethod, conf.partkey
+
+
+# ------------------------------------------------------------------ TPU path
+
+def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
+    """All diff rounds in-process on the mesh; per-worker rows recovered
+    from the routed results."""
+    from ..data.graph import Graph
+    from ..models.cpd import CPDOracle
+    from ..parallel.mesh import make_mesh
+
+    graph = Graph.from_xy(conf.xy_file)
+    mesh = make_mesh(n_workers=conf.maxworker)
+    oracle = CPDOracle(graph, dc, mesh=mesh)
+    try:
+        oracle.load(conf.outdir)
+    except FileNotFoundError:
+        log.info("no index at %s; building in-process", conf.outdir)
+        oracle.build(chunk=args.chunk)
+        oracle.save(conf.outdir)
+
+    owner = dc.worker_of(queries[:, 1])
+    stats = []
+    for diff in diffs:
+        with Timer() as prep:
+            w_query = (None if diff == "-"
+                       else graph.weights_with_diff(read_diff(diff)))
+        with Timer() as search:
+            cost, plen, fin = oracle.query(
+                queries, w_query=w_query, k_moves=args.k_moves,
+                active_worker=args.worker)
+        rows = []
+        for wid in range(dc.maxworker):
+            if args.worker != -1 and wid != args.worker:
+                continue
+            mask = owner == wid
+            size = int(mask.sum())
+            if size == 0:
+                continue
+            row = StatsRow(
+                n_expanded=int(plen[mask].sum()),
+                n_touched=size,
+                plen=int(plen[mask].sum()),
+                finished=int(fin[mask].sum()),
+                t_receive=prep.interval,
+                t_astar=search.interval,
+                t_search=search.interval,
+            )
+            rows.append(row.as_list(t_prepare=prep.interval,
+                                    t_partition=0.0, size=size))
+        stats.append(rows)
+    return stats
+
+
+# ----------------------------------------------------------------- host path
+
+def send_queries(host: str, wid: int, part: np.ndarray, rconf: RuntimeConfig,
+                 nfs: str, diff: str, t_partition: float = 0.0,
+                 timeout: float | None = fifo_transport.DEFAULT_TIMEOUT
+                 ) -> list:
+    """One worker's batch: write the query file, push the request through
+    the command FIFO, read the stats line (parity: reference
+    ``process_query.py:82-111``)."""
+    with Timer() as prep:
+        qfile = os.path.join(nfs, f"query.{host}{wid}")
+        write_query_file(qfile, part)
+    req = Request(rconf, qfile, answer_fifo_path(nfs, host, wid), diff)
+    row = fifo_transport.send_with_retry(host, req, command_fifo_path(wid),
+                                         timeout=timeout)
+    if not row.ok:
+        log.error("worker %d on %s failed; marking row failed", wid, host)
+    return row.as_list(t_prepare=prep.interval, t_partition=t_partition,
+                       size=len(part))
+
+
+def run_host(conf: ClusterConfig, args, queries, dc, diffs,
+             t_partition: float = 0.0):
+    rconf = runtime_config(args)
+    groups = dc.group_queries(queries, active_worker=args.worker)
+    # transport timeout is independent of the per-query search budget: a
+    # short --ms-lim must not kill the ssh/FIFO round-trip itself; a long
+    # budget extends the transport allowance proportionally
+    timeout = max(fifo_transport.DEFAULT_TIMEOUT,
+                  (get_time_ns(args) / 1e9) * 10)
+    stats = []
+    for diff in diffs:
+        jobs = [(conf.workers[wid], wid, part) for wid, part in
+                sorted(groups.items())]
+        rows = fan_out(jobs, lambda j: send_queries(
+            j[0], j[1], j[2], rconf, conf.nfs, diff,
+            t_partition=t_partition, timeout=timeout))
+        stats.append(rows)
+    return stats
+
+
+# ------------------------------------------------------------------- driver
+
+def run(conf: ClusterConfig, args):
+    """The campaign: returns ``(data, stats)`` with the reference's shapes
+    (reference ``process_query.py:132-194``)."""
+    scen = conf.scenfile or args.scenario
+    with Timer() as t_read:
+        queries = read_scen(scen)
+    log.info("read %d queries from %s", len(queries), scen)
+
+    with Timer() as t_workload:
+        partmethod, partkey = effective_partition(conf, args)
+        nodenum = xy_node_count(conf.xy_file)
+        dc = DistributionController(partmethod, partkey, conf.maxworker,
+                                    nodenum)
+    diffs = list(conf.diffs) if conf.diffs else list(args.diffs)
+
+    use_tpu = args.backend == "tpu" or (args.backend == "auto"
+                                        and partmethod == "tpu")
+    with Timer() as t_process:
+        if use_tpu:
+            stats = run_tpu(conf, args, queries, dc, diffs)
+        else:
+            stats = run_host(conf, args, queries, dc, diffs,
+                             t_partition=t_workload.interval)
+
+    data = {
+        "num_queries": int(len(queries)),
+        "num_partitions": conf.maxworker,
+        "t_read": t_read.interval,
+        "t_workload": t_workload.interval,
+        "t_process": t_process.interval,
+    }
+    return data, stats
+
+
+def output(data, stats, args) -> None:
+    """Print, or write the artifact trio (reference
+    ``process_query.py:196-239`` with the CSV bug fixed)."""
+    if args.output is None:
+        print(data)
+        print(STATS_HEADER)
+        for i, expe in enumerate(stats):
+            for row in expe:
+                print(i, row)
+        return
+    dirname = args.output
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "metrics.json"), "w") as f:
+        json.dump(data, f)
+    with open(os.path.join(dirname, "data.json"), "w") as f:
+        json.dump(vars(args), f)
+    with open(os.path.join(dirname, "parts.csv"), "w") as f:
+        writer = csv.writer(f, quoting=csv.QUOTE_MINIMAL)
+        writer.writerow(STATS_HEADER)
+        writer.writerows([i, *row] for i, expe in enumerate(stats)
+                         for row in expe)
+
+
+def test(args):
+    """Canned smoke campaign on the synthetic dataset (parity: reference
+    ``process_query.py:241-256``; TPU-mode by default, sized to the local
+    device count)."""
+    import jax
+
+    from ..data.synth import ensure_synth_dataset
+
+    conf = test_config(n_workers=len(jax.devices()))
+    ensure_synth_dataset(os.path.dirname(conf.xy_file) or "./data")
+    data, stats = run(conf, args)
+    output(data, stats, args)
+    return data, stats
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv, prog="process_query")
+    set_verbosity(args.verbose)
+    if args.debug:
+        # deterministic repro mode (parity: reference offline.py:143-147)
+        args.omp, args.verbose = 1, max(args.verbose, 2)
+    import contextlib
+    if args.profile:
+        import jax
+        trace = jax.profiler.trace(args.profile)
+    else:
+        trace = contextlib.nullcontext()
+    with trace:
+        if args.test:
+            test(args)
+            return 0
+        conf = ClusterConfig.load(args.c)
+        data, stats = run(conf, args)
+        output(data, stats, args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,10 +3,12 @@ from .formats import (
     read_xy, write_xy, read_scen, write_scen, read_diff, write_diff,
     xy_node_count,
 )
-from .synth import synth_city_graph, synth_scenario, synth_diff
+from .synth import (synth_city_graph, synth_scenario, synth_diff,
+                    ensure_synth_dataset)
 
 __all__ = [
     "Graph", "read_xy", "write_xy", "read_scen", "write_scen",
     "read_diff", "write_diff", "xy_node_count",
     "synth_city_graph", "synth_scenario", "synth_diff",
+    "ensure_synth_dataset",
 ]

@@ -86,3 +86,34 @@ def synth_diff(graph: Graph, frac: float = 0.1, seed: int = 2,
     factor = rng.uniform(*factor_range, k)
     new_w = np.maximum(1, (graph.w[eids] * factor).astype(np.int64)).astype(np.int32)
     return graph.src[eids], graph.dst[eids], new_w
+
+
+def ensure_synth_dataset(datadir: str, width: int = 24, height: int = 18,
+                         n_queries: int = 512, seed: int = 0) -> dict:
+    """Materialize the canned smoke-test dataset on disk (idempotent).
+
+    The no-cluster analog of the reference's demo data: writes
+    ``synth-city.xy``, ``synth.scen``, ``synth-city.xy.diff`` under
+    ``datadir`` if absent, matching the paths ``utils.config.test_config``
+    points at. Returns the path dict.
+    """
+    import os
+
+    from .formats import write_diff, write_scen, write_xy
+
+    os.makedirs(datadir, exist_ok=True)
+    xy = os.path.join(datadir, "synth-city.xy")
+    scen = os.path.join(datadir, "synth.scen")
+    diff = os.path.join(datadir, "synth-city.xy.diff")
+    if not os.path.exists(xy):
+        g = synth_city_graph(width, height, seed=seed)
+        write_xy(xy, g.xs, g.ys, g.src, g.dst, g.w)
+    if not os.path.exists(scen):
+        g = Graph.from_xy(xy)
+        write_scen(scen, synth_scenario(g.n, n_queries, seed=seed + 1),
+                   comment="synthetic smoke-test scenario")
+    if not os.path.exists(diff):
+        g = Graph.from_xy(xy)
+        src, dst, new_w = synth_diff(g, seed=seed + 2)
+        write_diff(diff, src, dst, new_w)
+    return {"xy": xy, "scen": scen, "diff": diff}

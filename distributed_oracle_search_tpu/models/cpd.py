@@ -43,6 +43,91 @@ from ..parallel.sharded import pad_targets, build_fm_sharded, query_sharded
 INDEX_VERSION = 1
 
 
+def shard_block_name(wid: int, bid: int) -> str:
+    return f"cpd-w{wid:05d}-b{bid:05d}.npy"
+
+
+def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
+                       outdir: str, chunk: int = 0, max_iters: int = 0,
+                       resume: bool = True) -> list[str]:
+    """Build and persist ONE worker's CPD block files on the local device.
+
+    This is the host-mode build unit: the reference launches one
+    ``make_cpd_auto`` per worker over ssh/tmux (``make_cpds.py:20-21``), each
+    emitting per-block CPD files; here one process builds its worker's rows
+    block-by-block with the batched min-plus kernel and writes
+    ``cpd-w<wid>-b<bid>.npy`` per block. ``resume=True`` skips blocks whose
+    file already exists — mid-build restart granularity the reference lacks
+    (SURVEY.md §5 checkpoint/resume).
+    """
+    from ..ops import build_fm_columns
+
+    os.makedirs(outdir, exist_ok=True)
+    dg = DeviceGraph.from_graph(graph)
+    owned = dc.owned(wid)
+    bs = dc.block_size
+    step = chunk if chunk > 0 else max(len(owned), 1)
+    # round the build step to a whole number of blocks so file granularity
+    # and compute granularity line up
+    step = max(bs, (step // bs) * bs)
+    n_blocks = (len(owned) + bs - 1) // bs
+    # only the missing blocks are computed — a restart after a partial
+    # build pays exactly for what is not yet on disk
+    missing = [bid for bid in range(n_blocks)
+               if not (resume and os.path.exists(
+                   os.path.join(outdir, shard_block_name(wid, bid))))]
+    written = []
+    per_step = step // bs
+    for g0 in range(0, len(missing), per_step):
+        group = missing[g0:g0 + per_step]
+        blocks = [owned[bid * bs: min((bid + 1) * bs, len(owned))]
+                  for bid in group]
+        tgts = np.concatenate(blocks)
+        pad = np.full(step, -1, np.int32)  # fixed shape -> one compile
+        pad[:len(tgts)] = tgts
+        fm = np.asarray(build_fm_columns(dg, jnp.asarray(pad),
+                                         max_iters=max_iters))
+        off = 0
+        for bid, blk in zip(group, blocks):
+            fname = shard_block_name(wid, bid)
+            np.save(os.path.join(outdir, fname), fm[off:off + len(blk)])
+            written.append(fname)
+            off += len(blk)
+    return written
+
+
+def write_index_manifest(outdir: str, dc: DistributionController,
+                         rows_per_worker: int | None = None) -> dict:
+    """Write ``index.json`` describing a complete per-block CPD index (the
+    head runs this after all workers' builds finish)."""
+    files = []
+    bs = dc.block_size
+    for wid in range(dc.maxworker):
+        n_owned = dc.n_owned(wid)
+        for bid in range((n_owned + bs - 1) // bs):
+            fname = shard_block_name(wid, bid)
+            if not os.path.exists(os.path.join(outdir, fname)):
+                raise FileNotFoundError(
+                    f"index incomplete: missing {fname} "
+                    f"(worker {wid} block {bid})")
+            files.append(fname)
+    manifest = {
+        "version": INDEX_VERSION,
+        "nodenum": dc.nodenum,
+        "maxworker": dc.maxworker,
+        "partmethod": dc.partmethod,
+        "partkey": (list(dc.partkey)
+                    if isinstance(dc.partkey, (list, tuple)) else dc.partkey),
+        "block_size": bs,
+        "rows_per_worker": (rows_per_worker if rows_per_worker is not None
+                            else max(dc.max_owned, 1)),
+        "files": files,
+    }
+    with open(os.path.join(outdir, "index.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    return manifest
+
+
 class CPDOracle:
     def __init__(self, graph: Graph, controller: DistributionController,
                  mesh=None):
@@ -74,29 +159,14 @@ class CPDOracle:
         os.makedirs(outdir, exist_ok=True)
         fm = np.asarray(self.fm)
         bs = self.dc.block_size
-        files = []
         for wid in range(self.dc.maxworker):
             n_owned = self.dc.n_owned(wid)
             for b0 in range(0, n_owned, bs):
-                bid = b0 // bs
                 rows = fm[wid, b0:min(b0 + bs, n_owned)]
-                fname = f"cpd-w{wid:05d}-b{bid:05d}.npy"
-                np.save(os.path.join(outdir, fname), rows)
-                files.append(fname)
-        manifest = {
-            "version": INDEX_VERSION,
-            "nodenum": self.dc.nodenum,
-            "maxworker": self.dc.maxworker,
-            "partmethod": self.dc.partmethod,
-            "partkey": (list(self.dc.partkey)
-                        if isinstance(self.dc.partkey, (list, tuple))
-                        else self.dc.partkey),
-            "block_size": bs,
-            "rows_per_worker": int(self.targets_wr.shape[1]),
-            "files": files,
-        }
-        with open(os.path.join(outdir, "index.json"), "w") as f:
-            json.dump(manifest, f, indent=2)
+                np.save(os.path.join(
+                    outdir, shard_block_name(wid, b0 // bs)), rows)
+        write_index_manifest(outdir, self.dc,
+                             rows_per_worker=int(self.targets_wr.shape[1]))
 
     def load(self, outdir: str) -> "CPDOracle":
         """Load a saved index onto the mesh, validating partition consistency

@@ -1,0 +1,19 @@
+"""Head↔worker data plane: wire formats, FIFO transport, job launch."""
+
+from .wire import (
+    ENGINE_STAT_FIELDS, HEAD_STAT_FIELDS, STATS_HEADER,
+    Request, RuntimeConfig, StatsRow,
+    read_query_file, write_query_file,
+)
+from .fifo import (
+    answer_fifo_path, command_fifo_path, fan_out, send, send_with_retry,
+)
+from .launch import kill_session, launch, session_name
+
+__all__ = [
+    "ENGINE_STAT_FIELDS", "HEAD_STAT_FIELDS", "STATS_HEADER",
+    "Request", "RuntimeConfig", "StatsRow",
+    "read_query_file", "write_query_file",
+    "answer_fifo_path", "command_fifo_path", "fan_out", "send",
+    "send_with_retry", "kill_session", "launch", "session_name",
+]

@@ -1,0 +1,121 @@
+"""Head-side transport: push a request to a worker, read its answer.
+
+Mechanism parity with the reference (``process_query.py:66-111``,
+``offline.py:70-125``): the head generates a small bash script —
+
+    mkfifo <answer>
+    cat > /tmp/worker<wid>.fifo <<EOF
+    <2-line request>
+    EOF
+    cat <answer>
+    rm <answer>
+
+— and pipes it through ``ssh <host> 'bash -s'``. The blocking FIFO opens are
+the rendezvous: the script blocks until the resident worker reads the
+command, and ``cat <answer>`` blocks until the worker writes its one CSV
+stats line.
+
+Improvements over the reference (SURVEY.md §2.1 quirks):
+
+* **real local path everywhere** — ``localhost``/``127.0.0.1`` runs the same
+  script via a local ``bash -s`` subprocess, no ssh round-trip (the reference
+  only had this in the legacy ``offline.py`` driver);
+* **explicit failure** — a dead worker yields ``StatsRow.failed()`` (and an
+  optional retry), not a garbage row silently entering the CSV
+  (reference ``process_query.py:107-109``);
+* timeouts on every blocking step.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from multiprocessing.dummy import Pool
+
+from .launch import LOCAL_HOSTS
+from .wire import Request, StatsRow
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+#: default transport timeout: generous enough for a cold-compile first
+#: batch over a slow link, finite so a dead worker cannot hang the campaign
+DEFAULT_TIMEOUT = 600.0
+
+
+def command_fifo_path(wid: int) -> str:
+    """Per-worker command FIFO (reference ``make_fifos.py`` convention)."""
+    return f"/tmp/worker{wid}.fifo"
+
+
+def answer_fifo_path(nfs: str, host: str, wid: int) -> str:
+    return f"{nfs.rstrip('/')}/answer.{host}{wid}"
+
+
+def make_script(request: Request, command_fifo: str) -> str:
+    """The transfer script run on the worker host (local or over ssh).
+
+    Guards the command FIFO with ``[ -p ... ]``: if no server is resident,
+    the reference's script shape would create a regular file and then block
+    forever on the answer; we fail fast with a distinct exit code instead.
+    """
+    payload = request.encode()
+    fifo = request.answerfifo
+    return (
+        f"[ -p {command_fifo} ] || "
+        f"{{ echo 'no resident worker on {command_fifo}' >&2; exit 3; }}\n"
+        f"mkfifo {fifo} 2>/dev/null || true\n"
+        f"cat > {command_fifo} <<'__DOS_EOF__'\n"
+        f"{payload}"
+        f"__DOS_EOF__\n"
+        f"cat {fifo}\n"
+        f"rm -f {fifo}\n"
+    )
+
+
+def send(host: str, request: Request, command_fifo: str,
+         timeout: float | None = DEFAULT_TIMEOUT) -> StatsRow:
+    """Run the transfer script on ``host`` and parse the stats line."""
+    script = make_script(request, command_fifo)
+    if host in LOCAL_HOSTS:
+        argv = ["bash", "-s"]
+    else:
+        argv = ["ssh", host, "bash -s"]
+    proc = subprocess.run(argv, input=script, capture_output=True,
+                          text=True, timeout=timeout)
+    if proc.returncode != 0:
+        log.error("worker transfer on %s failed (rc=%d): %s",
+                  host, proc.returncode, proc.stderr.strip())
+        return StatsRow.failed()
+    line = proc.stdout.strip().splitlines()
+    if not line:
+        log.error("worker on %s returned no stats line", host)
+        return StatsRow.failed()
+    try:
+        return StatsRow.decode(line[-1])
+    except ValueError as e:
+        log.error("bad stats line from %s: %s", host, e)
+        return StatsRow.failed()
+
+
+def send_with_retry(host: str, request: Request, command_fifo: str,
+                    timeout: float | None = DEFAULT_TIMEOUT,
+                    retries: int = 1) -> StatsRow:
+    for attempt in range(retries + 1):
+        try:
+            row = send(host, request, command_fifo, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            log.error("worker on %s timed out (attempt %d)", host, attempt)
+            row = StatsRow.failed()
+        if row.ok:
+            return row
+    return row
+
+
+def fan_out(jobs, fn, pool_size: int | None = None) -> list:
+    """Drive all workers concurrently, one thread per worker (parity with the
+    reference's ``multiprocessing.dummy.Pool``, ``process_query.py:180-185``).
+    """
+    if not jobs:
+        return []
+    with Pool(pool_size or len(jobs)) as pool:
+        return pool.map(fn, jobs)

@@ -1,0 +1,168 @@
+"""Wire formats of the head↔worker data plane.
+
+The reference's de-facto RPC schema (reference ``process_query.py:66-111``)
+is preserved exactly so artifacts and tooling stay interchangeable:
+
+* **request** — two text lines pushed through a worker's command FIFO:
+  line 1 = JSON runtime config (``hscale, fscale, time, itrs, k_moves,
+  threads, verbose, debug, thread_alloc, no_cache`` —
+  reference ``process_query.py:149-160``); line 2 =
+  ``<queryfile> <answerfifo> <difffile>`` (reference ``process_query.py:89``).
+* **query file** — first line = count, then one ``s t`` pair per line
+  (reference ``process_query.py:93-96``).
+* **response** — ONE CSV line of batch stats, field order fixed by the
+  header at reference ``process_query.py:198-213``:
+  ``n_expanded, n_inserted, n_touched, n_updated, n_surplus, plen,
+  finished, t_receive, t_astar, t_search``; the head appends
+  ``t_prepare, t_partition, size``.
+
+Everything here is pure encode/decode — no IO beyond the query-file
+helpers — so both the Python/JAX worker and the C++ engine can speak it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+#: engine-side stats fields, in wire order
+ENGINE_STAT_FIELDS = (
+    "n_expanded", "n_inserted", "n_touched", "n_updated", "n_surplus",
+    "plen", "finished", "t_receive", "t_astar", "t_search",
+)
+#: head-side appended fields
+HEAD_STAT_FIELDS = ("t_prepare", "t_partition", "size")
+
+#: answer-FIFO sentinel for an engine-side failure (a success row is a
+#: 10-field CSV line and can never equal this)
+FAIL_LINE = "FAIL"
+
+#: full per-row CSV header (reference ``process_query.py:198-213`` plus the
+#: leading experiment index the print path shows)
+STATS_HEADER = ["expe", *ENGINE_STAT_FIELDS, *HEAD_STAT_FIELDS]
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    """Per-batch engine knobs (wire line 1)."""
+
+    hscale: float = 1.0
+    fscale: float = 0.0
+    time: int = 0            # ns budget; 0 = unlimited
+    itrs: int = 1
+    k_moves: int = -1
+    threads: int = 0         # 0 = all
+    verbose: int = 0
+    debug: bool = False
+    thread_alloc: int = 0
+    no_cache: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, line: str) -> "RuntimeConfig":
+        d = json.loads(line)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class Request:
+    """A full 2-line command-FIFO request."""
+
+    config: RuntimeConfig
+    queryfile: str
+    answerfifo: str
+    difffile: str = "-"
+
+    def encode(self) -> str:
+        return (self.config.to_json() + "\n"
+                + f"{self.queryfile} {self.answerfifo} {self.difffile}\n")
+
+    @classmethod
+    def decode(cls, text: str) -> "Request":
+        lines = text.strip("\n").split("\n")
+        if len(lines) < 2:
+            raise ValueError(f"request needs 2 lines, got {len(lines)}")
+        qf, af, df = lines[1].split()
+        return cls(RuntimeConfig.from_json(lines[0]), qf, af, df)
+
+
+@dataclasses.dataclass
+class StatsRow:
+    """One batch's engine stats (wire CSV line)."""
+
+    n_expanded: int = 0
+    n_inserted: int = 0
+    n_touched: int = 0
+    n_updated: int = 0
+    n_surplus: int = 0
+    plen: int = 0
+    finished: int = 0
+    t_receive: float = 0.0
+    t_astar: float = 0.0
+    t_search: float = 0.0
+    ok: bool = True          # head-side: False marks a failed worker batch
+
+    def encode(self) -> str:
+        vals = [getattr(self, f) for f in ENGINE_STAT_FIELDS]
+        return ",".join(repr(v) if isinstance(v, float) else str(v)
+                        for v in vals)
+
+    @classmethod
+    def decode(cls, line: str) -> "StatsRow":
+        if line.strip() == FAIL_LINE:
+            return cls.failed()
+        parts = line.strip().split(",")
+        if len(parts) != len(ENGINE_STAT_FIELDS):
+            raise ValueError(
+                f"stats row has {len(parts)} fields, "
+                f"want {len(ENGINE_STAT_FIELDS)}: {line!r}")
+        kwargs = {}
+        for name, raw in zip(ENGINE_STAT_FIELDS, parts):
+            kwargs[name] = float(raw) if name.startswith("t_") else int(
+                float(raw))
+        return cls(**kwargs)
+
+    @classmethod
+    def failed(cls) -> "StatsRow":
+        """Explicit failure marker (vs the reference's garbage-row behavior,
+        reference ``process_query.py:107-109``)."""
+        return cls(ok=False)
+
+    def encode_wire(self) -> str:
+        """Wire line including the failure marker: failed rows encode as the
+        ``FAIL`` sentinel so the head can tell them from an all-zero batch
+        (success rows keep the reference's 10-field CSV exactly)."""
+        return FAIL_LINE if not self.ok else self.encode()
+
+    def as_list(self, t_prepare: float = 0.0, t_partition: float = 0.0,
+                size: int = 0) -> list:
+        """Full head-side row (engine fields + appended head fields)."""
+        return ([getattr(self, f) for f in ENGINE_STAT_FIELDS]
+                + [t_prepare, t_partition, size])
+
+
+# ----------------------------------------------------------- query files
+
+def write_query_file(path: str, queries: np.ndarray) -> None:
+    """count line, then ``s t`` per line (reference process_query.py:93-96)."""
+    queries = np.asarray(queries)
+    with open(path, "w") as f:
+        f.write(f"{len(queries)}\n")
+        np.savetxt(f, queries, fmt="%d")
+
+
+def read_query_file(path: str) -> np.ndarray:
+    with open(path) as f:
+        count = int(f.readline().split()[0])
+        if count == 0:
+            return np.zeros((0, 2), np.int64)
+        out = np.loadtxt(f, dtype=np.int64, ndmin=2)
+    if len(out) != count:
+        raise ValueError(f"{path}: header says {count} queries, "
+                         f"found {len(out)}")
+    return out.reshape(count, 2) if count else np.zeros((0, 2), np.int64)

@@ -1,0 +1,6 @@
+"""Worker-resident components: per-shard engine + FIFO server."""
+
+from .engine import ShardEngine, load_shard_rows
+from .server import FifoServer, stop_server
+
+__all__ = ["ShardEngine", "load_shard_rows", "FifoServer", "stop_server"]

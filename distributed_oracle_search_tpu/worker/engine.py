@@ -1,0 +1,142 @@
+"""Per-shard query engine: the worker-resident compute path.
+
+Role parity with the reference's resident ``fifo_auto`` process
+(SURVEY.md §2.2 C3): load the graph, the congestion diff, and THIS worker's
+CPD shard; then answer query batches for targets this shard owns. The
+reference answers each query in a C++ loop over OpenMP threads; here the
+whole batch is one XLA call — a vmapped first-move gather walk
+(``ops.table_search``) on whatever single device this worker process owns
+(TPU chip or CPU).
+
+Runtime knobs honored per batch (reference ``process_query.py:149-160``):
+``k_moves`` (move budget), ``itrs`` (repeat count; last result wins),
+``no_cache`` (drop the per-diff weight cache). ``time`` (ns budget) bounds
+only the ``itrs`` repetition loop — the batched XLA call itself is
+all-or-nothing, so a single batch cannot be cut short mid-flight; results
+are always complete and correct, never budget-truncated.
+``threads``/``thread_alloc`` are accepted for wire parity but are no-ops
+under XLA (SPMD inside one device replaces OpenMP, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import time
+
+import numpy as np
+
+from ..data.formats import read_diff
+from ..data.graph import Graph
+from ..parallel.partition import DistributionController
+from ..transport.wire import RuntimeConfig, StatsRow
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+def load_shard_rows(outdir: str, wid: int) -> np.ndarray:
+    """Load one worker's CPD rows from the block files the builder wrote
+    (``cpd-w<wid>-b<bid>.npy``; the index manifest is optional so a shard
+    can serve before the whole cluster's build completes)."""
+    pat = os.path.join(outdir, f"cpd-w{wid:05d}-b*.npy")
+    files = sorted(glob.glob(pat),
+                   key=lambda p: int(re.search(r"-b(\d+)\.npy$", p).group(1)))
+    if not files:
+        raise FileNotFoundError(f"no CPD blocks for worker {wid} in {outdir}")
+    return np.concatenate([np.load(f) for f in files], axis=0)
+
+
+class ShardEngine:
+    def __init__(self, graph: Graph, dc: DistributionController, wid: int,
+                 outdir: str):
+        import jax.numpy as jnp
+        from ..ops import DeviceGraph
+
+        self.graph = graph
+        self.dc = dc
+        self.wid = wid
+        self.fm = jnp.asarray(load_shard_rows(outdir, wid))
+        owned = dc.owned(wid)
+        if len(owned) != self.fm.shape[0]:
+            raise ValueError(
+                f"shard w{wid}: {self.fm.shape[0]} CPD rows but controller "
+                f"owns {len(owned)} nodes — partition mismatch")
+        self.dg = DeviceGraph.from_graph(graph)
+        self._weight_cache: dict[str, object] = {}
+
+    # ------------------------------------------------------------ weights
+    def _weights_for(self, difffile: str, no_cache: bool):
+        import jax.numpy as jnp
+        if difffile in self._weight_cache and not no_cache:
+            return self._weight_cache[difffile]
+        if difffile == "-":
+            w_pad = self.dg.w_pad
+        else:
+            w = self.graph.weights_with_diff(read_diff(difffile))
+            w_pad = jnp.asarray(self.graph.padded_weights(w), jnp.int32)
+        if no_cache:
+            self._weight_cache.clear()
+        else:
+            self._weight_cache[difffile] = w_pad
+        return w_pad
+
+    # -------------------------------------------------------------- batch
+    def answer(self, queries: np.ndarray, config: RuntimeConfig,
+               difffile: str = "-") -> tuple[np.ndarray, np.ndarray,
+                                             np.ndarray, StatsRow]:
+        """Answer a batch; returns (cost, plen, finished, stats)."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.table_search import table_search_batch
+
+        t0 = time.perf_counter()
+        w_pad = self._weights_for(difffile, config.no_cache)
+        nq = len(queries)
+        if nq == 0:
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, bool), StatsRow())
+        # pad to the next power of two: stable shapes, no recompiles as the
+        # per-worker batch size shifts between campaigns
+        qpad = 1 << (nq - 1).bit_length()
+        s = np.zeros(qpad, np.int32)
+        t = np.zeros(qpad, np.int32)
+        valid = np.zeros(qpad, bool)
+        s[:nq] = queries[:, 0]
+        t[:nq] = queries[:, 1]
+        valid[:nq] = True
+        rows = np.zeros(qpad, np.int32)
+        rows[:nq] = self.dc.owned_index_of(queries[:, 1])
+        owner = self.dc.worker_of(queries[:, 1])
+        if (owner != self.wid).any():
+            bad = int((owner != self.wid).sum())
+            raise ValueError(
+                f"shard w{self.wid} received {bad} queries for other "
+                "workers — routing invariant violated")
+
+        t1 = time.perf_counter()
+        deadline = t1 + config.time / 1e9 if config.time else None
+        for _ in range(max(config.itrs, 1)):
+            cost, plen, fin = table_search_batch(
+                self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
+                jnp.asarray(t), w_pad, valid=jnp.asarray(valid),
+                k_moves=config.k_moves)
+            jax.block_until_ready(fin)
+            if deadline is not None and time.perf_counter() > deadline:
+                break
+        t2 = time.perf_counter()
+
+        cost = np.asarray(cost[:nq], np.int64)
+        plen = np.asarray(plen[:nq], np.int64)
+        fin = np.asarray(fin[:nq], bool)
+        stats = StatsRow(
+            n_expanded=int(plen.sum()),   # node expansions = moves walked
+            n_touched=nq,
+            plen=int(plen.sum()),
+            finished=int(fin.sum()),
+            t_receive=t1 - t0,
+            t_astar=t2 - t1,
+            t_search=t2 - t0,
+        )
+        return cost, plen, fin, stats

@@ -1,0 +1,146 @@
+"""Resident query server: the framework's ``fifo_auto``.
+
+Behavior parity with reference C3 (SURVEY.md §2.2): on start, load the
+graph, the first diff, and this worker's CPD shard; create the command FIFO
+``/tmp/worker<wid>.fifo`` and block on it. Per request: parse the 2-line
+config (JSON knobs + ``queryfile answerfifo difffile``), read the query
+file, answer the batch, write ONE CSV stats line to the answer FIFO. Stays
+resident across requests.
+
+Extensions over the reference:
+
+* a ``__DOS_STOP__`` line on the command FIFO shuts the server down cleanly
+  (the reference can only be killed via tmux);
+* errors answer the FIFO with an all-zero failure row instead of leaving the
+  head blocked forever on ``cat <answer>``;
+* launched as ``python -m distributed_oracle_search_tpu.worker.server -c
+  conf.json --workerid N`` (by ``cli.make_fifos`` or by hand).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from ..data.graph import Graph
+from ..parallel.partition import DistributionController
+from ..transport.wire import Request, StatsRow, read_query_file
+from ..transport.fifo import command_fifo_path
+from ..utils.config import ClusterConfig
+from ..utils.log import get_logger, set_verbosity
+from .engine import ShardEngine
+
+log = get_logger(__name__)
+
+STOP_TOKEN = "__DOS_STOP__"
+
+
+class FifoServer:
+    def __init__(self, conf: ClusterConfig, wid: int,
+                 command_fifo: str | None = None):
+        self.conf = conf
+        self.wid = wid
+        self.command_fifo = command_fifo or command_fifo_path(wid)
+        graph = Graph.from_xy(conf.xy_file)
+        dc = DistributionController(conf.partmethod, conf.partkey,
+                                    conf.maxworker, graph.n)
+        self.engine = ShardEngine(graph, dc, wid, conf.outdir)
+        # preload the first diff's weights like the reference server does
+        # (make_fifos.py:18 loads only diffs[0])
+        if conf.diffs:
+            self.engine._weights_for(conf.diffs[0], no_cache=False)
+
+    # ------------------------------------------------------------ serving
+    def _ensure_fifo(self) -> None:
+        if os.path.exists(self.command_fifo):
+            os.remove(self.command_fifo)
+        os.mkfifo(self.command_fifo)
+
+    def handle(self, req: Request) -> StatsRow:
+        queries = read_query_file(req.queryfile)
+        _, _, _, stats = self.engine.answer(queries, req.config,
+                                            req.difffile)
+        return stats
+
+    def serve_forever(self) -> None:
+        self._ensure_fifo()
+        log.info("worker %d serving on %s", self.wid, self.command_fifo)
+        try:
+            while True:
+                # blocking open = rendezvous with the head's writer
+                with open(self.command_fifo) as f:
+                    text = f.read()
+                if STOP_TOKEN in text:
+                    log.info("worker %d: stop requested", self.wid)
+                    return
+                if not text.strip():
+                    continue
+                try:
+                    req = Request.decode(text)
+                except ValueError as e:
+                    log.error("bad request: %s", e)
+                    self._answer_malformed(text)
+                    continue
+                try:
+                    stats = self.handle(req)
+                except Exception as e:  # noqa: BLE001 — never leave the
+                    # head blocked on `cat answer`; send a failure row
+                    log.exception("batch failed: %s", e)
+                    stats = StatsRow.failed()
+                with open(req.answerfifo, "w") as f:
+                    f.write(stats.encode_wire() + "\n")
+        finally:
+            if os.path.exists(self.command_fifo):
+                os.remove(self.command_fifo)
+
+    def _answer_malformed(self, text: str) -> None:
+        """Best effort: recover the answer FIFO path from line 2 of a
+        malformed request and send the failure sentinel, so the head's
+        ``cat <answer>`` never blocks forever."""
+        lines = text.strip("\n").split("\n")
+        if len(lines) < 2:
+            return
+        tokens = lines[1].split()
+        if len(tokens) < 2:
+            return
+        answerfifo = tokens[1]
+        try:
+            if os.path.exists(answerfifo):
+                with open(answerfifo, "w") as f:
+                    f.write(StatsRow.failed().encode_wire() + "\n")
+        except OSError as e:
+            log.error("could not answer malformed request: %s", e)
+
+    def stop_file(self) -> None:
+        """Write the stop token into our own FIFO (for another process)."""
+        with open(self.command_fifo, "w") as f:
+            f.write(STOP_TOKEN + "\n")
+
+
+def stop_server(command_fifo: str) -> None:
+    with open(command_fifo, "w") as f:
+        f.write(STOP_TOKEN + "\n")
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-c", default="./example-cluster-conf.json",
+                   help="cluster config JSON")
+    p.add_argument("-w", "--workerid", type=int, required=True)
+    p.add_argument("--fifo", default=None,
+                   help="command FIFO path override")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    args = p.parse_args(argv)
+    set_verbosity(args.verbose)
+
+    conf = ClusterConfig.load(args.c)
+    server = FifoServer(conf, args.workerid, command_fifo=args.fifo)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,325 @@
+"""End-to-end driver tests: shard build -> resident servers -> campaign.
+
+The no-cluster analog of the reference's ``-t`` smoke mode (N workers on
+localhost, SURVEY.md §4): host-mode runs the real FIFO wire protocol against
+resident servers in background threads (no ssh — the local bash path), and
+TPU-mode runs the whole campaign in-process on the virtual 8-device mesh.
+"""
+
+import csv
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.cli.args import parse_args
+from distributed_oracle_search_tpu.cli import process_query as pq
+from distributed_oracle_search_tpu.cli import offline as offline_mod
+from distributed_oracle_search_tpu.cli.make_cpds import run_host, run_tpu
+from distributed_oracle_search_tpu.data import (
+    Graph, ensure_synth_dataset, read_diff, read_scen,
+)
+from distributed_oracle_search_tpu.models.cpd import write_index_manifest
+from distributed_oracle_search_tpu.models.reference import dist_to_target
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.transport.wire import STATS_HEADER
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker import (
+    FifoServer, ShardEngine, stop_server,
+)
+from distributed_oracle_search_tpu.worker.build import main as build_main
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    datadir = str(tmp_path_factory.mktemp("data"))
+    paths = ensure_synth_dataset(datadir, width=10, height=8, n_queries=96,
+                                 seed=13)
+    return datadir, paths
+
+
+@pytest.fixture(scope="module")
+def host_conf(dataset):
+    datadir, paths = dataset
+    conf = ClusterConfig(
+        workers=["localhost", "localhost"],
+        partmethod="mod", partkey=2,
+        outdir=os.path.join(datadir, "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=["-", paths["diff"]],
+        nfs=datadir,
+    ).validate()
+    path = os.path.join(datadir, "conf.json")
+    conf.save(path)
+    return conf, path
+
+
+@pytest.fixture(scope="module")
+def built_index(host_conf):
+    conf, _ = host_conf
+    # the make_cpd_auto-equivalent CLI, one invocation per worker
+    for wid in range(conf.maxworker):
+        build_main(["--input", conf.xy_file, "--partmethod", conf.partmethod,
+                    "--partkey", str(conf.partkey),
+                    "--workerid", str(wid),
+                    "--maxworker", str(conf.maxworker),
+                    "--outdir", conf.outdir])
+    g = Graph.from_xy(conf.xy_file)
+    dc = DistributionController(conf.partmethod, conf.partkey,
+                                conf.maxworker, g.n)
+    write_index_manifest(conf.outdir, dc)
+    return g, dc
+
+
+def test_shard_engine_matches_cpu_oracle(host_conf, built_index):
+    conf, _ = host_conf
+    g, dc = built_index
+    queries = read_scen(conf.scenfile)
+    eng = ShardEngine(g, dc, wid=1, outdir=conf.outdir)
+    mine = queries[dc.worker_of(queries[:, 1]) == 1][:16]
+    cost, plen, fin, stats = eng.answer(
+        mine, pq.runtime_config(parse_args([])))
+    assert fin.all() and stats.finished == len(mine)
+    for (s, t), c in zip(mine, cost):
+        assert c == dist_to_target(g, int(t))[int(s)]
+
+
+def test_shard_engine_applies_diff(host_conf, built_index):
+    conf, _ = host_conf
+    g, dc = built_index
+    diff = conf.diffs[1]
+    queries = read_scen(conf.scenfile)
+    mine = queries[dc.worker_of(queries[:, 1]) == 0][:8]
+    eng = ShardEngine(g, dc, wid=0, outdir=conf.outdir)
+    cost, plen, fin, _ = eng.answer(
+        mine, pq.runtime_config(parse_args([])), difffile=diff)
+    # costs accumulate on perturbed weights while moves follow free-flow
+    # first moves (reference semantics, SURVEY.md §0)
+    w_diff = g.weights_with_diff(read_diff(diff))
+    free_cost, _, _, _ = eng.answer(mine, pq.runtime_config(parse_args([])))
+    assert (cost >= free_cost).all() and (cost > free_cost).any()
+    assert fin.all()
+    del w_diff
+
+
+def test_shard_engine_rejects_misrouted(host_conf, built_index):
+    conf, _ = host_conf
+    g, dc = built_index
+    queries = read_scen(conf.scenfile)
+    other = queries[dc.worker_of(queries[:, 1]) == 0][:4]
+    eng = ShardEngine(g, dc, wid=1, outdir=conf.outdir)
+    with pytest.raises(ValueError, match="routing invariant"):
+        eng.answer(other, pq.runtime_config(parse_args([])))
+
+
+def test_host_campaign_over_fifo(host_conf, built_index, monkeypatch,
+                                 tmp_path):
+    """Full host-mode campaign through the real FIFO wire protocol."""
+    conf, _ = host_conf
+    fifos = {wid: str(tmp_path / f"worker{wid}.fifo")
+             for wid in range(conf.maxworker)}
+    monkeypatch.setattr(pq, "command_fifo_path", lambda wid: fifos[wid])
+
+    servers = [FifoServer(conf, wid, command_fifo=fifos[wid])
+               for wid in range(conf.maxworker)]
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in servers]
+    for t in threads:
+        t.start()
+    try:
+        args = parse_args(["--backend", "host"])
+        data, stats = pq.run(conf, args)
+    finally:
+        for wid in fifos:
+            try:
+                stop_server(fifos[wid])
+            except OSError:
+                pass
+        for t in threads:
+            t.join(timeout=10)
+
+    queries = read_scen(conf.scenfile)
+    assert data["num_queries"] == len(queries)
+    assert len(stats) == len(conf.diffs)          # one round per diff
+    for expe in stats:
+        assert len(expe) == conf.maxworker
+        total = sum(row[-1] for row in expe)       # size column
+        finished = sum(row[6] for row in expe)     # finished column
+        assert total == len(queries)
+        assert finished == len(queries)
+
+
+def test_tpu_campaign_and_artifacts(dataset, tmp_path):
+    """TPU-mode: in-process sharded campaign + artifact trio."""
+    datadir, paths = dataset
+    conf = ClusterConfig(
+        workers=[f"tpu:{i}" for i in range(8)],
+        partmethod="tpu", partkey=8,
+        outdir=str(tmp_path / "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=["-", paths["diff"]],
+    ).validate()
+    out = str(tmp_path / "artifacts")
+    args = parse_args(["-o", out])
+    data, stats = pq.run(conf, args)
+    pq.output(data, stats, args)
+
+    queries = read_scen(conf.scenfile)
+    for expe in stats:
+        assert sum(row[-1] for row in expe) == len(queries)
+        assert sum(row[6] for row in expe) == len(queries)
+
+    with open(os.path.join(out, "parts.csv")) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == STATS_HEADER
+    # every data row: expe index + full stats width (the reference's CSV
+    # writer crashed for != 2 workers; ours must not)
+    assert all(len(r) == len(STATS_HEADER) for r in rows[1:])
+    assert {r[0] for r in rows[1:]} == {"0", "1"}
+    metrics = json.load(open(os.path.join(out, "metrics.json")))
+    assert metrics["num_queries"] == len(queries)
+    assert json.load(open(os.path.join(out, "data.json")))["output"] == out
+
+
+def test_tpu_campaign_matches_cpu_oracle(dataset, tmp_path):
+    datadir, paths = dataset
+    conf = ClusterConfig(
+        workers=[f"tpu:{i}" for i in range(4)],
+        partmethod="tpu", partkey=4,
+        outdir=str(tmp_path / "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"], diffs=["-"],
+    ).validate()
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("tpu", None, 4, g.n)
+    args = parse_args([])
+    queries = read_scen(conf.scenfile)[:24]
+    stats = pq.run_tpu(conf, args, queries, dc, ["-"])
+    assert sum(r[6] for r in stats[0]) == len(queries)
+    # independently verify via the saved index + a fresh engine
+    eng = ShardEngine(g, dc, wid=0, outdir=conf.outdir)
+    mine = queries[dc.worker_of(queries[:, 1]) == 0]
+    cost, _, fin, _ = eng.answer(mine, pq.runtime_config(args))
+    for (s, t), c in zip(mine, cost):
+        assert c == dist_to_target(g, int(t))[int(s)]
+
+
+def test_worker_select_flag(dataset, tmp_path):
+    """-w restricts the campaign to one worker (reference -w filter)."""
+    datadir, paths = dataset
+    conf = ClusterConfig(
+        workers=[f"tpu:{i}" for i in range(4)],
+        partmethod="tpu", partkey=4,
+        outdir=str(tmp_path / "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"], diffs=["-"],
+    ).validate()
+    args = parse_args(["-w", "2"])
+    data, stats = pq.run(conf, args)
+    g_n = Graph.from_xy(paths["xy"]).n
+    dc = DistributionController("tpu", None, 4, g_n)
+    queries = read_scen(conf.scenfile)
+    expect = int((dc.worker_of(queries[:, 1]) == 2).sum())
+    assert len(stats[0]) == 1
+    assert stats[0][0][-1] == expect
+
+
+# ------------------------------------------------------------- make_parts
+
+def _reqs(n=50, seed=3, n_nodes=200):
+    rng = np.random.default_rng(seed)
+    return np.stack([rng.integers(0, n_nodes, n),
+                     rng.integers(0, n_nodes, n)], axis=1)
+
+
+def _covers_exactly(parts, reqs):
+    got = np.concatenate(parts) if parts else np.zeros((0, 2), np.int64)
+    a = sorted(map(tuple, got))
+    b = sorted(map(tuple, reqs))
+    assert a == b
+
+
+@pytest.mark.parametrize("argv", [
+    [], ["--group", "all"], ["--group", "mod"], ["--group", "div"],
+    ["--alloc", "50", "120", "200"], ["--sort"],
+    ["--group", "all", "--sort"],
+])
+def test_make_parts_partitions_exactly(argv):
+    args = parse_args(argv)
+    reqs = _reqs()
+    parts = offline_mod.make_parts(reqs, args, num_parts=4)
+    _covers_exactly(parts, reqs)
+
+
+def test_make_parts_all_keeps_target_groups_whole():
+    args = parse_args(["--group", "all"])
+    reqs = _reqs(80)
+    parts = offline_mod.make_parts(reqs, args, num_parts=5)
+    seen = {}
+    for i, p in enumerate(parts):
+        for t in np.unique(p[:, 1]):
+            assert seen.setdefault(int(t), i) == i, \
+                "a destination group was split across parts"
+
+
+def test_make_parts_sort_orders_by_target():
+    args = parse_args(["--sort"])
+    parts = offline_mod.make_parts(_reqs(), args, num_parts=3)
+    for p in parts:
+        assert (np.diff(p[:, 1]) >= 0).all()
+
+
+def test_build_resume_computes_only_missing_blocks(dataset, tmp_path):
+    """Deleting one block file and re-running rebuilds exactly that block."""
+    from distributed_oracle_search_tpu.models.cpd import (
+        build_worker_shard, shard_block_name,
+    )
+    datadir, paths = dataset
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n, block_size=16)
+    out = str(tmp_path / "idx")
+    first = build_worker_shard(g, dc, 0, out, chunk=16)
+    assert len(first) == (dc.n_owned(0) + 15) // 16
+    again = build_worker_shard(g, dc, 0, out, chunk=16)
+    assert again == []          # everything on disk -> nothing recomputed
+    victim = shard_block_name(0, 1)
+    os.remove(os.path.join(out, victim))
+    redo = build_worker_shard(g, dc, 0, out, chunk=16)
+    assert redo == [victim]
+
+    # and the rebuilt index still matches the CPU oracle
+    eng = ShardEngine(g, dc, wid=0, outdir=out)
+    queries = read_scen(paths["scen"])
+    mine = queries[dc.worker_of(queries[:, 1]) == 0][:8]
+    cost, _, fin, _ = eng.answer(mine, pq.runtime_config(parse_args([])))
+    assert fin.all()
+    for (s, t), c in zip(mine, cost):
+        assert c == dist_to_target(g, int(t))[int(s)]
+
+
+def test_server_answers_malformed_request(host_conf, built_index, tmp_path):
+    """A corrupt request must not leave the head blocked: the server sends
+    the FAIL sentinel to the answer FIFO recovered from line 2."""
+    conf, _ = host_conf
+    fifo = str(tmp_path / "wm.fifo")
+    server = FifoServer(conf, 0, command_fifo=fifo)
+    answer = str(tmp_path / "ans.fifo")
+    os.mkfifo(answer)
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    import time
+    for _ in range(100):
+        if os.path.exists(fifo):
+            break
+        time.sleep(0.05)
+    try:
+        with open(fifo, "w") as f:
+            f.write("this is not json\nqueryfile %s -\n" % answer)
+        with open(answer) as f:          # blocks until the server answers
+            line = f.read().strip()
+        assert line == "FAIL"
+    finally:
+        stop_server(fifo)
+        th.join(timeout=10)

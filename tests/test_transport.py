@@ -1,0 +1,118 @@
+"""Wire-format round-trips and the head-side transfer script.
+
+The FIFO wire schema is the reference's de-facto RPC contract
+(``process_query.py:66-111``); these tests pin it.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.transport.wire import (
+    ENGINE_STAT_FIELDS, Request, RuntimeConfig, StatsRow,
+    read_query_file, write_query_file,
+)
+from distributed_oracle_search_tpu.transport.fifo import make_script
+
+
+def test_runtime_config_roundtrip():
+    rc = RuntimeConfig(hscale=1.5, fscale=0.2, time=123456789, itrs=3,
+                       k_moves=7, threads=4, verbose=2, debug=True,
+                       thread_alloc=1, no_cache=True)
+    assert RuntimeConfig.from_json(rc.to_json()) == rc
+
+
+def test_runtime_config_ignores_unknown_keys():
+    rc = RuntimeConfig.from_json('{"hscale": 2.0, "future_knob": 1}')
+    assert rc.hscale == 2.0
+
+
+def test_request_roundtrip():
+    req = Request(RuntimeConfig(), "/nfs/query.host3", "/nfs/answer.host3",
+                  "/data/melb.diff")
+    back = Request.decode(req.encode())
+    assert back == req
+    assert req.encode().count("\n") == 2  # exactly two wire lines
+
+
+def test_request_decode_rejects_short():
+    with pytest.raises(ValueError):
+        Request.decode("{}")
+
+
+def test_stats_row_roundtrip():
+    row = StatsRow(n_expanded=10, n_inserted=1, n_touched=5, n_updated=2,
+                   n_surplus=0, plen=42, finished=5, t_receive=0.25,
+                   t_astar=1.5, t_search=1.75)
+    back = StatsRow.decode(row.encode())
+    assert back == row
+    assert len(row.encode().split(",")) == len(ENGINE_STAT_FIELDS)
+
+
+def test_stats_row_decode_rejects_bad_width():
+    with pytest.raises(ValueError):
+        StatsRow.decode("1,2,3")
+
+
+def test_stats_as_list_appends_head_fields():
+    row = StatsRow(plen=9, finished=3)
+    full = row.as_list(t_prepare=0.1, t_partition=0.2, size=3)
+    assert full[-3:] == [0.1, 0.2, 3]
+    assert len(full) == len(ENGINE_STAT_FIELDS) + 3
+
+
+def test_query_file_roundtrip(tmp_path):
+    q = np.array([[1, 2], [3, 4], [100000, 7]], np.int64)
+    path = str(tmp_path / "query.host0")
+    write_query_file(path, q)
+    assert (read_query_file(path) == q).all()
+    # header line = count (reference process_query.py:93-96)
+    assert open(path).readline().strip() == "3"
+
+
+def test_query_file_empty(tmp_path):
+    path = str(tmp_path / "query.empty")
+    write_query_file(path, np.zeros((0, 2), np.int64))
+    assert read_query_file(path).shape == (0, 2)
+
+
+def test_query_file_count_mismatch(tmp_path):
+    path = str(tmp_path / "query.bad")
+    with open(path, "w") as f:
+        f.write("2\n1 2\n")
+    with pytest.raises(ValueError):
+        read_query_file(path)
+
+
+def test_make_script_shape():
+    req = Request(RuntimeConfig(), "/nfs/q", "/nfs/a", "-")
+    script = make_script(req, "/tmp/worker0.fifo")
+    # mkfifo answer; heredoc into command fifo; cat answer; rm answer —
+    # the reference's transfer script shape (process_query.py:71-77)
+    assert "mkfifo /nfs/a" in script
+    assert "cat > /tmp/worker0.fifo" in script
+    assert "cat /nfs/a" in script
+    assert "rm -f /nfs/a" in script
+    assert "/nfs/q /nfs/a -" in script
+
+
+def test_fail_sentinel_roundtrip():
+    row = StatsRow.failed()
+    assert row.encode_wire() == "FAIL"
+    back = StatsRow.decode(row.encode_wire())
+    assert not back.ok
+
+
+def test_success_row_encode_wire_is_csv():
+    row = StatsRow(plen=5, finished=2)
+    assert row.encode_wire() == row.encode()
+    assert StatsRow.decode(row.encode_wire()).ok
+
+
+def test_send_fails_fast_without_resident_worker(tmp_path):
+    """No server on the command FIFO -> failure row, no hang (the script's
+    [ -p ] guard)."""
+    from distributed_oracle_search_tpu.transport.fifo import send
+    req = Request(RuntimeConfig(), str(tmp_path / "q"),
+                  str(tmp_path / "a"), "-")
+    row = send("localhost", req, str(tmp_path / "no-such.fifo"), timeout=10)
+    assert not row.ok
