@@ -121,6 +121,10 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
     new.add_argument("--chunk", type=int, default=0,
                      help="CPD build: target rows per build step "
                           "(0 = all owned rows at once).")
+    new.add_argument("--engine", choices=["python", "native"],
+                     default="python",
+                     help="Host-mode worker engine: the JAX shard engine "
+                          "or the native C++ binaries (./install.sh).")
     return p
 
 

@@ -30,11 +30,21 @@ from ..utils.log import get_logger, set_verbosity
 log = get_logger(__name__)
 
 
-def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0) -> str:
+def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0,
+                     engine: str = "python") -> str:
     """The shell command a host-mode worker runs (our ``make_cpd_auto``)."""
     partkey = (" ".join(str(b) for b in conf.partkey)
                if isinstance(conf.partkey, (list, tuple))
                else str(conf.partkey))
+    if engine == "native":
+        from ..utils.nativebin import require_binary
+        if chunk:
+            log.warning("--chunk is a JAX-builder staging knob; the native "
+                        "builder works block-by-block and ignores it")
+        return (f"{require_binary('make_cpd_auto')}"
+                f" --input {conf.xy_file} --partmethod {conf.partmethod}"
+                f" --partkey {partkey} --workerid {wid}"
+                f" --maxworker {conf.maxworker} --outdir {conf.outdir}")
     cmd = (f"{sys.executable} -m distributed_oracle_search_tpu.worker.build"
            f" --input {conf.xy_file} --partmethod {conf.partmethod}"
            f" --partkey {partkey} --workerid {wid}"
@@ -44,13 +54,14 @@ def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0) -> str:
     return cmd
 
 
-def call_worker(wid: int, conf: ClusterConfig, chunk: int = 0):
+def call_worker(wid: int, conf: ClusterConfig, chunk: int = 0,
+                engine: str = "python"):
     """Launch one worker's build (parity: reference ``make_cpds.py:10-25``).
 
     Returns a Popen handle when the build runs as a tracked local
     subprocess, else None (tmux/ssh detached)."""
     host = conf.workers[wid]
-    cmd = worker_build_cmd(wid, conf, chunk)
+    cmd = worker_build_cmd(wid, conf, chunk, engine)
     log.info("launch build w%d on %s: %s", wid, host, cmd)
     # prefer_track: builds are finite jobs — await local ones so the index
     # manifest can be finalized when they all complete
@@ -81,7 +92,7 @@ def run_host(conf: ClusterConfig, args) -> None:
     for wid in range(conf.maxworker):
         if args.worker != -1 and wid != args.worker:
             continue
-        proc = call_worker(wid, conf, chunk=args.chunk)
+        proc = call_worker(wid, conf, chunk=args.chunk, engine=args.engine)
         if proc is not None:
             procs.append((wid, proc))
     failures = 0

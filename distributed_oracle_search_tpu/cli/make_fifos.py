@@ -28,7 +28,21 @@ from ..utils.log import get_logger, set_verbosity
 log = get_logger(__name__)
 
 
-def worker_server_cmd(wid: int, conf_path: str, verbose: int = 0) -> str:
+def worker_server_cmd(wid: int, conf_path: str, verbose: int = 0,
+                      engine: str = "python",
+                      conf: ClusterConfig | None = None) -> str:
+    if engine == "native":
+        from ..utils.nativebin import require_binary
+        assert conf is not None
+        partkey = (" ".join(str(b) for b in conf.partkey)
+                   if isinstance(conf.partkey, (list, tuple))
+                   else str(conf.partkey))
+        diff = conf.diffs[0] if conf.diffs else "-"
+        return (f"{require_binary('fifo_auto')}"
+                f" --input {conf.xy_file} {diff}"
+                f" --partmethod {conf.partmethod} --partkey {partkey}"
+                f" --workerid {wid} --maxworker {conf.maxworker}"
+                f" --outdir {conf.outdir} --alg table-search")
     cmd = (f"{sys.executable} -m distributed_oracle_search_tpu.worker.server"
            f" -c {conf_path} --workerid {wid}")
     if verbose:
@@ -37,9 +51,9 @@ def worker_server_cmd(wid: int, conf_path: str, verbose: int = 0) -> str:
 
 
 def call_worker(wid: int, conf: ClusterConfig, conf_path: str,
-                verbose: int = 0):
+                verbose: int = 0, engine: str = "python"):
     host = conf.workers[wid]
-    cmd = worker_server_cmd(wid, conf_path, verbose)
+    cmd = worker_server_cmd(wid, conf_path, verbose, engine, conf)
     log.info("launch server w%d on %s: %s", wid, host, cmd)
     return launch(host, session_name("fifo", wid), cmd,
                   projectdir=conf.projectdir)
@@ -64,7 +78,8 @@ def main(argv=None) -> int:
     for wid in range(conf.maxworker):
         if args.worker != -1 and wid != args.worker:
             continue
-        proc = call_worker(wid, conf, conf_path, args.verbose)
+        proc = call_worker(wid, conf, conf_path, args.verbose,
+                           engine=args.engine)
         if proc is not None:
             procs.append((wid, proc))
     print(f"launched {conf.maxworker if args.worker == -1 else 1} "

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Build the native engine and copy its binaries to ./bin — entry-point
+# parity with the reference's install.sh (reference install.sh:1-27):
+#   ./install.sh [dev|fast]     (default: fast)
+set -euo pipefail
+
+flavor="${1:-fast}"
+case "$flavor" in
+  dev|fast) ;;
+  *) echo "usage: $0 [dev|fast]" >&2; exit 2 ;;
+esac
+
+cd "$(dirname "$0")"
+make -C native "$flavor" -j"$(nproc)"
+mkdir -p bin
+for prog in make_cpd_auto gen_distribute_conf fifo_auto; do
+  cp "native/build/$flavor/bin/$prog" bin/
+done
+echo "installed $flavor binaries to ./bin"

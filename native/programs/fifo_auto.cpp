@@ -1,0 +1,247 @@
+// fifo_auto — resident query server (native).
+//
+// Role + protocol parity with reference C3 (SURVEY.md §2.2; launched at
+// reference make_fifos.py:21): load the graph, the first diff, and this
+// worker's CPD shard; create the command FIFO and block on it. Per
+// request: parse the 2-line config (JSON knobs + "queryfile answerfifo
+// difffile"), read the query file, answer every (s,t) by table-search
+// (OpenMP over queries), write ONE CSV stats line to the answer FIFO.
+// Stays resident across requests.
+//
+//   fifo_auto --input <xy> [<diff>] --partmethod M --partkey K...
+//             --workerid W --maxworker N --outdir <idxdir>
+//             --alg table-search|astar [--compress] [--fifo <path>]
+//
+// --alg astar serves the hscale/fscale weighted-A* family (the knobs the
+// reference exposes, args.py:30-57) straight off the graph — no CPD
+// needed — emitting the full priority-queue telemetry.
+//
+// Speaks the same wire as the Python worker/server.py, including the
+// __DOS_STOP__ shutdown token and the FAIL failure sentinel, so the head
+// drivers cannot tell the two apart. --compress keeps the shard
+// run-length-encoded in memory (the reference's CPD compression trade).
+
+#include <omp.h>
+
+#include <cerrno>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <unistd.h>
+#include <vector>
+
+#include "../src/cpd.hpp"
+#include "../src/distribution_controller.hpp"
+#include "../src/graph.hpp"
+#include "../src/search.hpp"
+
+using namespace dos;
+
+static double now_s() {
+    struct timeval tv;
+    gettimeofday(&tv, nullptr);
+    return tv.tv_sec + tv.tv_usec * 1e-6;
+}
+
+// minimal flat-JSON number/bool extraction for the runtime-config line
+// (wire schema: transport/wire.py RuntimeConfig)
+static double json_num(const std::string& j, const std::string& key,
+                       double dflt) {
+    auto p = j.find("\"" + key + "\"");
+    if (p == std::string::npos) return dflt;
+    p = j.find(':', p);
+    if (p == std::string::npos) return dflt;
+    ++p;
+    while (p < j.size() && (j[p] == ' ' || j[p] == '\t')) ++p;
+    if (!j.compare(p, 4, "true")) return 1;
+    if (!j.compare(p, 5, "false")) return 0;
+    try {
+        return std::stod(j.substr(p));
+    } catch (...) { return dflt; }
+}
+
+struct Server {
+    Graph g;
+    DistributionController dc;
+    CpdShard shard;
+    int64_t wid;
+    std::string fifo_path;
+    std::string alg;  // table-search | astar
+    std::map<std::string, std::vector<int32_t>> weight_cache;
+
+    Server(Graph gg, DistributionController dcc, CpdShard sh, int64_t w,
+           std::string fifo, std::string algo)
+        : g(std::move(gg)), dc(std::move(dcc)), shard(std::move(sh)),
+          wid(w), fifo_path(std::move(fifo)), alg(std::move(algo)) {}
+
+    const std::vector<int32_t>& weights_for(const std::string& diff,
+                                            bool no_cache) {
+        if (no_cache) weight_cache.clear();
+        auto it = weight_cache.find(diff);
+        if (it != weight_cache.end()) return it->second;
+        return weight_cache.emplace(diff, weights_with_diff(g, diff))
+            .first->second;
+    }
+
+    std::string handle(const std::string& cfg_json,
+                       const std::string& queryfile,
+                       const std::string& difffile) {
+        double t0 = now_s();
+        int64_t k_moves = int64_t(json_num(cfg_json, "k_moves", -1));
+        int threads = int(json_num(cfg_json, "threads", 0));
+        bool no_cache = json_num(cfg_json, "no_cache", 0) != 0;
+        int64_t itrs = std::max<int64_t>(1, int64_t(json_num(cfg_json, "itrs", 1)));
+        double hscale = json_num(cfg_json, "hscale", 1.0);
+        double fscale = json_num(cfg_json, "fscale", 0.0);
+        const std::vector<int32_t>& wq = weights_for(difffile, no_cache);
+        auto queries = load_query_file(queryfile);
+        // routing invariant (same loud failure as the Python ShardEngine):
+        // every query's target must be owned by this worker
+        for (auto& [s, t] : queries) {
+            (void)s;
+            if (t < 0 || t >= dc.nodenum || dc.wid_of[t] != wid)
+                die("routing invariant violated: query targets node " +
+                    std::to_string(t) + " not owned by worker " +
+                    std::to_string(wid));
+        }
+        double t1 = now_s();
+
+        bool use_astar = alg == "astar";
+        double cpu = use_astar ? min_cost_per_unit(g, wq) : 0.0;
+        SearchStats total;
+        if (threads > 0) omp_set_num_threads(threads);
+        for (int64_t it = 0; it < itrs; ++it) {
+            SearchStats round;
+#pragma omp parallel
+            {
+                SearchStats local;
+#pragma omp for schedule(dynamic, 64)
+                for (size_t q = 0; q < queries.size(); ++q) {
+                    auto [s, t] = queries[q];
+                    if (use_astar) {
+                        astar(g, s, t, wq, hscale, fscale, local, cpu);
+                        continue;
+                    }
+                    int64_t row = dc.owned_idx[t];
+                    auto fm = [&](int64_t x) {
+                        return shard.first_move(row, x);
+                    };
+                    QueryResult r = table_search(g, fm, s, t, wq, k_moves);
+                    local.n_expanded += r.plen;
+                    local.n_touched += 1;
+                    local.plen += r.plen;
+                    local.finished += r.finished ? 1 : 0;
+                }
+#pragma omp critical
+                round += local;
+            }
+            total = round;  // last iteration wins (wire parity with python)
+        }
+        double t2 = now_s();
+        char buf[256];
+        std::snprintf(buf, sizeof buf,
+                      "%ld,%ld,%ld,%ld,%ld,%ld,%ld,%.9f,%.9f,%.9f",
+                      total.n_expanded, total.n_inserted, total.n_touched,
+                      total.n_updated, total.n_surplus, total.plen,
+                      total.finished, t1 - t0, t2 - t1, t2 - t0);
+        return buf;
+    }
+
+    [[noreturn]] void serve() {
+        ::unlink(fifo_path.c_str());
+        if (::mkfifo(fifo_path.c_str(), 0666) != 0)
+            die("mkfifo " + fifo_path + ": " + std::strerror(errno));
+        std::fprintf(stderr, "fifo_auto: worker %ld serving on %s\n", wid,
+                     fifo_path.c_str());
+        while (true) {
+            std::ifstream f(fifo_path);  // blocking-open rendezvous
+            std::stringstream ss;
+            ss << f.rdbuf();
+            std::string text = ss.str();
+            if (text.find("__DOS_STOP__") != std::string::npos) {
+                ::unlink(fifo_path.c_str());
+                std::exit(0);
+            }
+            auto nl = text.find('\n');
+            if (nl == std::string::npos) continue;
+            std::string cfg = text.substr(0, nl);
+            std::istringstream l2(text.substr(nl + 1));
+            std::string queryfile, answerfifo, difffile;
+            l2 >> queryfile >> answerfifo >> difffile;
+            if (answerfifo.empty()) continue;
+            std::string reply;
+            try {
+                reply = handle(cfg, queryfile, difffile);
+            } catch (...) {
+                reply = "FAIL";  // never leave the head blocked
+            }
+            std::ofstream out(answerfifo);
+            out << reply << "\n";
+        }
+    }
+};
+
+static int real_main(int argc, char** argv) {
+    std::string input, diff = "-", partmethod, outdir = ".", alg =
+        "table-search", fifo;
+    std::vector<int64_t> partkey;
+    int64_t workerid = -1, maxworker = -1,
+            block_size = DEFAULT_BLOCK_SIZE;
+    bool compress = false;
+    for (int i = 1; i < argc; ++i) {
+        std::string a = argv[i];
+        auto next = [&]() -> std::string {
+            if (i + 1 >= argc) die("missing value for " + a);
+            return argv[++i];
+        };
+        if (a == "--input") {
+            input = next();
+            if (i + 1 < argc && argv[i + 1][0] != '-') diff = argv[++i];
+            else if (i + 1 < argc && std::strcmp(argv[i + 1], "-") == 0)
+                diff = argv[++i];
+        } else if (a == "--partmethod") partmethod = next();
+        else if (a == "--partkey") {
+            while (i + 1 < argc && (argv[i + 1][0] != '-' ||
+                                    std::isdigit(argv[i + 1][1])))
+                partkey.push_back(std::stoll(argv[++i]));
+        } else if (a == "--workerid") workerid = std::stoll(next());
+        else if (a == "--maxworker") maxworker = std::stoll(next());
+        else if (a == "--outdir") outdir = next();
+        else if (a == "--alg") alg = next();
+        else if (a == "--block-size") block_size = std::stoll(next());
+        else if (a == "--compress") compress = true;
+        else if (a == "--fifo") fifo = next();
+        else die("unknown flag " + a);
+    }
+    if (input.empty() || partmethod.empty() || workerid < 0 || maxworker <= 0)
+        die("usage: fifo_auto --input XY [DIFF] --partmethod M --partkey K "
+            "--workerid W --maxworker N --outdir D --alg table-search");
+    if (alg != "table-search" && alg != "astar")
+        die("--alg must be table-search (reference make_fifos.py:20) or "
+            "astar (this framework's hscale/fscale family)");
+    if (partkey.empty()) partkey.push_back(1);
+    if (fifo.empty())
+        fifo = "/tmp/worker" + std::to_string(workerid) + ".fifo";
+
+    Graph g = load_xy(input);
+    DistributionController dc(partmethod, partkey, maxworker, g.n,
+                              block_size);
+    // astar needs no first-move table; table-search loads its CPD shard
+    CpdShard shard;
+    if (alg == "table-search")
+        shard = CpdShard::load(outdir, workerid, dc.n_owned(workerid),
+                               block_size, compress);
+    Server server(std::move(g), std::move(dc), std::move(shard), workerid,
+                  fifo, alg);
+    // preload the first diff like the reference server (make_fifos.py:18)
+    server.weights_for(diff, false);
+    server.serve();
+}
+
+int main(int argc, char** argv) {
+    return run_main([&] { return real_main(argc, argv); });
+}

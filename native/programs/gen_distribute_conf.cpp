@@ -1,0 +1,47 @@
+// gen_distribute_conf — partition oracle (native).
+//
+// CLI + wire parity with reference C2 (SURVEY.md §2.2; invoked at reference
+// process_query.py:46):
+//   gen_distribute_conf --nodenum N --maxworker W --partmethod M
+//                       --partkey K...
+// Stdout: header line + one CSV row per node: node,wid,bid,bidx.
+// Pure function of its flags; must agree byte-for-byte with the Python
+// cli.gen_distribute_conf (tests cross-check).
+
+#include <string>
+#include <vector>
+
+#include "../src/distribution_controller.hpp"
+
+using namespace dos;
+
+static int real_main(int argc, char** argv) {
+    int64_t nodenum = -1, maxworker = -1;
+    std::string partmethod;
+    std::vector<int64_t> partkey;
+    for (int i = 1; i < argc; ++i) {
+        std::string a = argv[i];
+        auto next = [&]() -> std::string {
+            if (i + 1 >= argc) die("missing value for " + a);
+            return argv[++i];
+        };
+        if (a == "--nodenum") nodenum = std::stoll(next());
+        else if (a == "--maxworker") maxworker = std::stoll(next());
+        else if (a == "--partmethod") partmethod = next();
+        else if (a == "--partkey") {
+            while (i + 1 < argc && argv[i + 1][0] != '-')
+                partkey.push_back(std::stoll(argv[++i]));
+        } else die("unknown flag " + a);
+    }
+    if (nodenum < 0 || maxworker <= 0 || partmethod.empty())
+        die("usage: gen_distribute_conf --nodenum N --maxworker W "
+            "--partmethod M --partkey K...");
+    if (partkey.empty()) partkey.push_back(1);
+    DistributionController dc(partmethod, partkey, maxworker, nodenum);
+    dc.print_conf(stdout);
+    return 0;
+}
+
+int main(int argc, char** argv) {
+    return run_main([&] { return real_main(argc, argv); });
+}

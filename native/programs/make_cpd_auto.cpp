@@ -1,0 +1,109 @@
+// make_cpd_auto — per-worker CPD builder (native).
+//
+// CLI parity with reference C1 (SURVEY.md §2.2; invoked at reference
+// make_cpds.py:20):
+//   make_cpd_auto --input <xy> --partmethod <div|mod|alloc|tpu>
+//                 --partkey <int...> --workerid <w> --maxworker <n>
+//                 [--outdir <dir>] [--block-size <b>] [--no-resume]
+//
+// One reverse-Dijkstra sweep per owned target, OpenMP over all cores
+// (reference README.md:95), emitting the same cpd-w*-b*.npy block files as
+// the Python builder (worker/build.py) — the two backends' indexes are
+// interchangeable. Re-running skips blocks already on disk.
+
+#include <omp.h>
+
+#include <cstring>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+#include "../src/cpd.hpp"
+#include "../src/distribution_controller.hpp"
+#include "../src/graph.hpp"
+
+using namespace dos;
+
+static bool file_exists(const std::string& p) {
+    struct stat st;
+    return ::stat(p.c_str(), &st) == 0;
+}
+
+static int real_main(int argc, char** argv) {
+    std::string input, partmethod, outdir;
+    std::vector<int64_t> partkey;
+    int64_t workerid = -1, maxworker = -1,
+            block_size = DEFAULT_BLOCK_SIZE;
+    bool resume = true;
+    for (int i = 1; i < argc; ++i) {
+        std::string a = argv[i];
+        auto next = [&]() -> std::string {
+            if (i + 1 >= argc) die("missing value for " + a);
+            return argv[++i];
+        };
+        if (a == "--input") input = next();
+        else if (a == "--partmethod" || a == "--partition")
+            partmethod = next();
+        else if (a == "--partkey") {
+            while (i + 1 < argc && argv[i + 1][0] != '-')
+                partkey.push_back(std::stoll(argv[++i]));
+        } else if (a == "--workerid") workerid = std::stoll(next());
+        else if (a == "--maxworker") maxworker = std::stoll(next());
+        else if (a == "--outdir") outdir = next();
+        else if (a == "--block-size") block_size = std::stoll(next());
+        else if (a == "--no-resume") resume = false;
+        else die("unknown flag " + a);
+    }
+    if (input.empty() || partmethod.empty() || workerid < 0 || maxworker <= 0)
+        die("usage: make_cpd_auto --input XY --partmethod M --partkey K "
+            "--workerid W --maxworker N [--outdir D]");
+    if (outdir.empty()) {  // default: the input's directory (README.md:93)
+        auto slash = input.find_last_of('/');
+        outdir = slash == std::string::npos ? "." : input.substr(0, slash);
+    }
+    if (partkey.empty()) partkey.push_back(1);
+
+    ::mkdir(outdir.c_str(), 0777);  // single level, EEXIST is fine
+
+    Graph g = load_xy(input);
+    DistributionController dc(partmethod, partkey, maxworker, g.n,
+                              block_size);
+    std::vector<int64_t> owned = dc.owned(workerid);
+    int64_t n_blocks =
+        (static_cast<int64_t>(owned.size()) + block_size - 1) / block_size;
+
+    std::vector<int64_t> todo;
+    for (int64_t bid = 0; bid < n_blocks; ++bid)
+        if (!resume || !file_exists(outdir + "/" + block_name(workerid, bid)))
+            todo.push_back(bid);
+
+    int64_t written = 0;
+    for (int64_t bid : todo) {
+        int64_t r0 = bid * block_size;
+        int64_t rows =
+            std::min(block_size, static_cast<int64_t>(owned.size()) - r0);
+        Int8Matrix blk;
+        blk.rows = rows;
+        blk.cols = g.n;
+        blk.data.resize(rows * g.n);
+#pragma omp parallel
+        {
+            std::vector<int64_t> dist;  // per-thread scratch
+#pragma omp for schedule(dynamic)
+            for (int64_t r = 0; r < rows; ++r) {
+                int64_t target = owned[r0 + r];
+                dist_to_target(g, target, g.w, dist);
+                first_move_row(g, target, g.w, dist, &blk.data[r * g.n]);
+            }
+        }
+        npy_write_i8(outdir + "/" + block_name(workerid, bid), blk);
+        ++written;
+    }
+    std::printf("worker %ld: %ld block(s) -> %s\n", workerid, written,
+                outdir.c_str());
+    return 0;
+}
+
+int main(int argc, char** argv) {
+    return run_main([&] { return real_main(argc, argv); });
+}

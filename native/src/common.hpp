@@ -1,0 +1,37 @@
+// Shared basics for the native engine.
+//
+// TPU-native framework's host-side C++ engine: plays the role warthog plays
+// in the reference (SURVEY.md §2.2 C5) — CPU correctness oracle and
+// host-mode worker compute. Semantics are kept in lock-step with the
+// Python/JAX side (models/reference.py, ops/): int32 weights, INF = 1e9
+// (INF + INF fits int32), first-move = out-edge slot ordered by ascending
+// edge id, ties to the smallest slot.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <stdexcept>
+#include <string>
+
+namespace dos {
+
+constexpr int32_t INF = 1000000000;  // matches data/graph.py INF
+
+// Throws rather than exits so a resident server can answer FAIL and stay
+// up; program main()s catch at top level and exit 1.
+[[noreturn]] inline void die(const std::string& msg) {
+    throw std::runtime_error(msg);
+}
+
+template <typename F>
+int run_main(F&& body) {
+    try {
+        return body();
+    } catch (const std::exception& e) {
+        std::fprintf(stderr, "error: %s\n", e.what());
+        return 1;
+    }
+}
+
+}  // namespace dos

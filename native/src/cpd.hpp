@@ -1,0 +1,147 @@
+// CPD construction and shard container.
+//
+// The native build path: one reverse-Dijkstra sweep per owned target (the
+// reference's approach, README.md:95 — contrast the JAX path's batched
+// min-plus iteration in ops/bellman_ford.py), then first-move extraction
+// with the shared tie-break rule (smallest slot). Produces the same int8
+// [rows, N] block files as the Python side (npy.hpp), so indexes are
+// interchangeable.
+//
+// In memory a shard can be kept raw (row-major int8, O(rows*N)) or
+// run-length compressed (the reference's trade: CPD first-move rows are
+// long runs — row storage drops ~50-100x on road networks at the cost of a
+// binary search per lookup; SURVEY.md §7 notes this is wrong for TPU but
+// right for a CPU resident server).
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <limits>
+#include <queue>
+#include <vector>
+
+#include "common.hpp"
+#include "graph.hpp"
+#include "npy.hpp"
+
+namespace dos {
+
+// d(x -> target) for all x: Dijkstra over in-edges from target.
+inline void dist_to_target(const Graph& g, int64_t target,
+                           const std::vector<int32_t>& w,
+                           std::vector<int64_t>& dist /* [n], scratch */) {
+    dist.assign(g.n, INF);
+    dist[target] = 0;
+    using QE = std::pair<int64_t, int64_t>;  // (dist, node)
+    std::priority_queue<QE, std::vector<QE>, std::greater<QE>> pq;
+    pq.emplace(0, target);
+    while (!pq.empty()) {
+        auto [d, v] = pq.top();
+        pq.pop();
+        if (d > dist[v]) continue;
+        for (int64_t p = g.in_ptr[v]; p < g.in_ptr[v + 1]; ++p) {
+            int32_t e = g.in_eid[p];
+            int64_t u = g.src[e];
+            int64_t nd = d + w[e];
+            if (nd < dist[u]) {
+                dist[u] = nd;
+                pq.emplace(nd, u);
+            }
+        }
+    }
+}
+
+// first-move row: slot k of x minimizing w[eid(x,k)] + d(nbr -> target);
+// first minimal slot wins (models/reference.py first_move_to_target parity)
+inline void first_move_row(const Graph& g, int64_t target,
+                           const std::vector<int32_t>& w,
+                           const std::vector<int64_t>& dist,
+                           int8_t* row /* [n] */) {
+    for (int64_t x = 0; x < g.n; ++x) {
+        if (x == target) { row[x] = -1; continue; }
+        int64_t best = INF;
+        int8_t best_slot = -1;
+        int32_t deg = g.out_degree(x);
+        for (int32_t k = 0; k < deg; ++k) {
+            int32_t e = g.out_edge_at(x, k);
+            int64_t cand = w[e] + dist[g.dst[e]];
+            if (cand < best) { best = cand; best_slot = static_cast<int8_t>(k); }
+        }
+        row[x] = best >= INF ? int8_t(-1) : best_slot;
+    }
+}
+
+inline std::string block_name(int64_t wid, int64_t bid) {
+    char buf[64];
+    std::snprintf(buf, sizeof buf, "cpd-w%05ld-b%05ld.npy", wid, bid);
+    return buf;
+}
+
+// ------------------------------------------------------ run-length rows
+
+struct RleRow {
+    // runs[i] = (start column, move); row value at c = move of the last
+    // run with start <= c
+    std::vector<std::pair<int32_t, int8_t>> runs;
+
+    static RleRow encode(const int8_t* row, int64_t n) {
+        RleRow r;
+        for (int64_t c = 0; c < n; ++c)
+            if (c == 0 || row[c] != row[c - 1])
+                r.runs.emplace_back(static_cast<int32_t>(c), row[c]);
+        return r;
+    }
+
+    int8_t lookup(int64_t c) const {
+        auto it = std::upper_bound(
+            runs.begin(), runs.end(),
+            std::make_pair(static_cast<int32_t>(c),
+                           std::numeric_limits<int8_t>::max()));
+        return (--it)->second;
+    }
+};
+
+// A worker's resident CPD shard: rows indexed by owned index of the target.
+struct CpdShard {
+    int64_t n = 0;          // columns (graph nodes)
+    bool compressed = false;
+    Int8Matrix raw;                 // when !compressed
+    std::vector<RleRow> rle;        // when compressed
+
+    int8_t first_move(int64_t row, int64_t x) const {
+        return compressed ? rle[row].lookup(x) : raw.at(row, x);
+    }
+
+    // load all of a worker's block files from outdir (ascending bid)
+    static CpdShard load(const std::string& outdir, int64_t wid,
+                         int64_t n_owned, int64_t block_size,
+                         bool compress) {
+        CpdShard s;
+        s.compressed = compress;
+        int64_t n_blocks = (n_owned + block_size - 1) / block_size;
+        int64_t row0 = 0;
+        for (int64_t bid = 0; bid < n_blocks; ++bid) {
+            Int8Matrix blk = npy_read_i8(outdir + "/" + block_name(wid, bid));
+            if (s.n == 0) s.n = blk.cols;
+            if (blk.cols != s.n) die("inconsistent CPD block width");
+            if (compress) {
+                for (int64_t r = 0; r < blk.rows; ++r)
+                    s.rle.push_back(
+                        RleRow::encode(&blk.data[r * blk.cols], blk.cols));
+            } else {
+                if (row0 == 0) {
+                    s.raw.rows = n_owned;
+                    s.raw.cols = blk.cols;
+                    s.raw.data.resize(n_owned * blk.cols);
+                }
+                std::copy(blk.data.begin(), blk.data.end(),
+                          s.raw.data.begin() + row0 * blk.cols);
+            }
+            row0 += blk.rows;
+        }
+        if (row0 != n_owned) die("CPD shard rows != owned node count");
+        return s;
+    }
+};
+
+}  // namespace dos

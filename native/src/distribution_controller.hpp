@@ -1,0 +1,86 @@
+// Node→worker partition policy: the native distribution controller.
+//
+// Role parity with the reference's src/util/distribution_controller.h
+// (SURVEY.md §2.2 C4): the single source of truth shared by the CPD
+// builder, the query servers, and the router, so build-time sharding and
+// query-time routing stay consistent. Semantics mirror
+// parallel/partition.py exactly (the two are cross-checked by tests):
+//   div:   wid = node / partkey
+//   mod:   wid = node % partkey
+//   alloc: wid = first i with bounds[i] > node (ascending bounds)
+//   tpu:   wid = node / ceil(nodenum / maxworker)
+// bid/bidx: each worker's owned nodes ascending, split into blocks of
+// block_size; bid*block_size+bidx = dense row in the worker's CPD shard.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.hpp"
+
+namespace dos {
+
+constexpr int64_t DEFAULT_BLOCK_SIZE = 1 << 14;  // parallel/partition.py parity
+
+struct DistributionController {
+    std::string partmethod;
+    std::vector<int64_t> partkey;  // 1 value, or per-worker alloc bounds
+    int64_t maxworker = 1;
+    int64_t nodenum = 0;
+    int64_t block_size = DEFAULT_BLOCK_SIZE;
+
+    std::vector<int32_t> wid_of;     // [n]
+    std::vector<int64_t> owned_idx;  // [n] dense row within owner's shard
+    std::vector<int64_t> counts;     // [w]
+
+    DistributionController(std::string method, std::vector<int64_t> key,
+                           int64_t maxw, int64_t n,
+                           int64_t bs = DEFAULT_BLOCK_SIZE)
+        : partmethod(std::move(method)), partkey(std::move(key)),
+          maxworker(maxw), nodenum(n), block_size(bs) {
+        wid_of.resize(n);
+        owned_idx.resize(n);
+        counts.assign(maxworker, 0);
+        int64_t chunk = (n + maxworker - 1) / maxworker;
+        for (int64_t node = 0; node < n; ++node) {
+            int64_t w;
+            if (partmethod == "div") w = node / partkey.at(0);
+            else if (partmethod == "mod") w = node % partkey.at(0);
+            else if (partmethod == "tpu") w = node / (chunk ? chunk : 1);
+            else if (partmethod == "alloc") {
+                w = 0;
+                while (w < static_cast<int64_t>(partkey.size()) &&
+                       partkey[w] <= node)
+                    ++w;
+            } else die("unknown partmethod " + partmethod);
+            if (w < 0 || w >= maxworker)
+                die("node maps outside maxworker (partmethod=" +
+                    partmethod + ")");
+            wid_of[node] = static_cast<int32_t>(w);
+            owned_idx[node] = counts[w]++;  // nodes ascend => owned ascend
+        }
+    }
+
+    int64_t n_owned(int64_t w) const { return counts[w]; }
+
+    std::vector<int64_t> owned(int64_t w) const {
+        std::vector<int64_t> out;
+        out.reserve(counts[w]);
+        for (int64_t node = 0; node < nodenum; ++node)
+            if (wid_of[node] == w) out.push_back(node);
+        return out;
+    }
+
+    // the gen_distribute_conf wire format: header + node,wid,bid,bidx rows
+    // (parsed by the reference driver at process_query.py:50-53)
+    void print_conf(FILE* f) const {
+        std::fprintf(f, "node,wid,bid,bidx\n");
+        for (int64_t node = 0; node < nodenum; ++node)
+            std::fprintf(f, "%ld,%d,%ld,%ld\n", node, wid_of[node],
+                         owned_idx[node] / block_size,
+                         owned_idx[node] % block_size);
+    }
+};
+
+}  // namespace dos

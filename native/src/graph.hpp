@@ -1,0 +1,179 @@
+// Road-graph container + file formats (.xy / .scen / .diff).
+//
+// Format parity with the Python side (data/formats.py docstring grammar);
+// semantic parity with data/graph.py: CSR by src with edge ids ascending
+// within each node (file order == ascending edge id), so "out-edge slot k
+// of node u" means the same thing to this engine, the CPU oracle, and the
+// JAX kernels — first-move tables are interchangeable byte-for-byte.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.hpp"
+
+namespace dos {
+
+struct Graph {
+    int64_t n = 0, m = 0;
+    std::vector<int64_t> xs, ys;          // node coordinates
+    std::vector<int32_t> src, dst, w;     // edges, file order
+    std::vector<int64_t> out_ptr;         // CSR by src (eids ascending)
+    std::vector<int32_t> out_eid;
+    std::vector<int64_t> in_ptr;          // CSR by dst
+    std::vector<int32_t> in_eid;
+
+    int32_t out_degree(int64_t u) const {
+        return static_cast<int32_t>(out_ptr[u + 1] - out_ptr[u]);
+    }
+    // slot k of u: k-th out-edge in ascending edge-id order
+    int32_t out_edge_at(int64_t u, int32_t slot) const {
+        return out_eid[out_ptr[u] + slot];
+    }
+
+    void build_csr() {
+        out_ptr.assign(n + 1, 0);
+        in_ptr.assign(n + 1, 0);
+        for (int64_t e = 0; e < m; ++e) {
+            out_ptr[src[e] + 1]++;
+            in_ptr[dst[e] + 1]++;
+        }
+        for (int64_t i = 0; i < n; ++i) {
+            out_ptr[i + 1] += out_ptr[i];
+            in_ptr[i + 1] += in_ptr[i];
+        }
+        out_eid.resize(m);
+        in_eid.resize(m);
+        std::vector<int64_t> oc(out_ptr.begin(), out_ptr.end() - 1);
+        std::vector<int64_t> ic(in_ptr.begin(), in_ptr.end() - 1);
+        for (int64_t e = 0; e < m; ++e) {  // file order => ascending eid
+            out_eid[oc[src[e]]++] = static_cast<int32_t>(e);
+            in_eid[ic[dst[e]]++] = static_cast<int32_t>(e);
+        }
+    }
+
+    int64_t edge_id(int64_t u, int64_t v) const {
+        for (int64_t p = out_ptr[u]; p < out_ptr[u + 1]; ++p)
+            if (dst[out_eid[p]] == v) return out_eid[p];
+        return -1;
+    }
+};
+
+// xy grammar (data/formats.py): 3 header lines, then
+// "p <n> <m> 0", n "v x y" lines, m "e src dst w" lines.
+inline Graph load_xy(const std::string& path) {
+    FILE* f = std::fopen(path.c_str(), "r");
+    if (!f) die("cannot open xy file " + path);
+    char line[256];
+    Graph g;
+    int64_t nv = 0, ne = 0;
+    // scan for the 'p' line (4th line; node count = 2nd token —
+    // the structural fact the reference driver relies on,
+    // reference process_query.py:126-130)
+    while (std::fgets(line, sizeof line, f)) {
+        if (line[0] == 'p') {
+            if (std::sscanf(line, "p %ld %ld", &nv, &ne) != 2)
+                die(path + ": bad p line");
+            break;
+        }
+    }
+    if (!nv) die(path + ": no p line");
+    g.n = nv;
+    g.m = ne;
+    g.xs.resize(nv);
+    g.ys.resize(nv);
+    g.src.reserve(ne);
+    g.dst.reserve(ne);
+    g.w.reserve(ne);
+    int64_t vi = 0;
+    while (std::fgets(line, sizeof line, f)) {
+        if (line[0] == 'v') {
+            long x, y;
+            if (std::sscanf(line, "v %ld %ld", &x, &y) != 2)
+                die(path + ": bad v line");
+            if (vi >= nv) die(path + ": too many v lines");
+            g.xs[vi] = x;
+            g.ys[vi] = y;
+            ++vi;
+        } else if (line[0] == 'e') {
+            long a, b, ww;
+            if (std::sscanf(line, "e %ld %ld %ld", &a, &b, &ww) != 3)
+                die(path + ": bad e line");
+            g.src.push_back(static_cast<int32_t>(a));
+            g.dst.push_back(static_cast<int32_t>(b));
+            g.w.push_back(static_cast<int32_t>(ww));
+        }
+    }
+    std::fclose(f);
+    if (vi != nv || static_cast<int64_t>(g.src.size()) != ne)
+        die(path + ": node/edge count mismatch with p line");
+    g.build_csr();
+    return g;
+}
+
+// scen grammar: 'q <s> <t>' per query line.
+inline std::vector<std::pair<int64_t, int64_t>>
+load_scen(const std::string& path) {
+    FILE* f = std::fopen(path.c_str(), "r");
+    if (!f) die("cannot open scen file " + path);
+    char line[256];
+    std::vector<std::pair<int64_t, int64_t>> out;
+    while (std::fgets(line, sizeof line, f)) {
+        if (line[0] == 'q') {
+            long s, t;
+            if (std::sscanf(line, "q %ld %ld", &s, &t) == 2)
+                out.emplace_back(s, t);
+        }
+    }
+    std::fclose(f);
+    return out;
+}
+
+// query-file format (wire): first line = count, then "s t" per line
+// (reference process_query.py:93-96).
+inline std::vector<std::pair<int64_t, int64_t>>
+load_query_file(const std::string& path) {
+    FILE* f = std::fopen(path.c_str(), "r");
+    if (!f) die("cannot open query file " + path);
+    long count = 0;
+    if (std::fscanf(f, "%ld", &count) != 1)
+        die(path + ": missing count line");
+    std::vector<std::pair<int64_t, int64_t>> out;
+    out.reserve(count);
+    for (long i = 0; i < count; ++i) {
+        long s, t;
+        if (std::fscanf(f, "%ld %ld", &s, &t) != 2)
+            die(path + ": truncated query file");
+        out.emplace_back(s, t);
+    }
+    std::fclose(f);
+    return out;
+}
+
+// diff grammar: 'd <count>' then '<src> <dst> <new_w>' lines; applied to
+// query-time weights only (reference semantics, SURVEY.md §0).
+inline std::vector<int32_t> weights_with_diff(const Graph& g,
+                                              const std::string& path) {
+    std::vector<int32_t> w = g.w;
+    if (path == "-" || path.empty()) return w;
+    FILE* f = std::fopen(path.c_str(), "r");
+    if (!f) die("cannot open diff file " + path);
+    char line[256];
+    while (std::fgets(line, sizeof line, f)) {
+        if (line[0] == 'd' || line[0] == 'c') continue;
+        long a, b, nw;
+        if (std::sscanf(line, "%ld %ld %ld", &a, &b, &nw) == 3) {
+            int64_t e = g.edge_id(a, b);
+            if (e < 0) die(path + ": diff names absent edge");
+            w[e] = static_cast<int32_t>(nw);
+        }
+    }
+    std::fclose(f);
+    return w;
+}
+
+}  // namespace dos

@@ -1,0 +1,85 @@
+// Minimal .npy v1.0 reader/writer for 2-D int8 arrays.
+//
+// CPD block files (cpd-w*-b*.npy) are shared between the Python/JAX side
+// (numpy.save in models/cpd.py) and this engine: an index built by either
+// side serves on the other. Only the |i1 dtype, C-order, 2-D case is
+// supported — exactly what a first-move block is.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.hpp"
+
+namespace dos {
+
+struct Int8Matrix {
+    int64_t rows = 0, cols = 0;
+    std::vector<int8_t> data;  // row-major
+    int8_t at(int64_t r, int64_t c) const { return data[r * cols + c]; }
+};
+
+inline void npy_write_i8(const std::string& path, const Int8Matrix& m) {
+    std::string header = "{'descr': '|i1', 'fortran_order': False, "
+                         "'shape': (" + std::to_string(m.rows) + ", " +
+                         std::to_string(m.cols) + "), }";
+    // pad header so that magic(6)+ver(2)+len(2)+header is a multiple of 64
+    size_t base = 6 + 2 + 2;
+    size_t total = base + header.size() + 1;  // +1 for '\n'
+    size_t pad = (64 - total % 64) % 64;
+    header.append(pad, ' ');
+    header.push_back('\n');
+    FILE* f = std::fopen(path.c_str(), "wb");
+    if (!f) die("cannot write " + path);
+    const unsigned char magic[8] = {0x93, 'N', 'U', 'M', 'P', 'Y', 1, 0};
+    std::fwrite(magic, 1, 8, f);
+    uint16_t hlen = static_cast<uint16_t>(header.size());
+    std::fwrite(&hlen, 2, 1, f);
+    std::fwrite(header.data(), 1, header.size(), f);
+    std::fwrite(m.data.data(), 1, m.data.size(), f);
+    std::fclose(f);
+}
+
+inline Int8Matrix npy_read_i8(const std::string& path) {
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) die("cannot read " + path);
+    unsigned char magic[8];
+    if (std::fread(magic, 1, 8, f) != 8 || std::memcmp(magic, "\x93NUMPY", 6))
+        die(path + ": not a .npy file");
+    uint32_t hlen = 0;
+    if (magic[6] == 1) {  // v1.0: 2-byte little-endian header length
+        uint16_t h16;
+        if (std::fread(&h16, 2, 1, f) != 1) die(path + ": truncated header");
+        hlen = h16;
+    } else {              // v2.0+: 4-byte
+        if (std::fread(&hlen, 4, 1, f) != 1) die(path + ": truncated header");
+    }
+    std::string header(hlen, '\0');
+    if (std::fread(header.data(), 1, hlen, f) != hlen)
+        die(path + ": truncated header");
+    if (header.find("'|i1'") == std::string::npos &&
+        header.find("\"|i1\"") == std::string::npos)
+        die(path + ": expected int8 (|i1) dtype");
+    if (header.find("False") == std::string::npos)
+        die(path + ": fortran_order arrays unsupported");
+    size_t sp = header.find("'shape':");
+    if (sp == std::string::npos) die(path + ": no shape in header");
+    sp = header.find('(', sp);
+    size_t ep = header.find(')', sp);
+    std::string shape = header.substr(sp + 1, ep - sp - 1);
+    Int8Matrix m;
+    if (std::sscanf(shape.c_str(), "%ld , %ld", &m.rows, &m.cols) != 2 &&
+        std::sscanf(shape.c_str(), "%ld ,%ld", &m.rows, &m.cols) != 2 &&
+        std::sscanf(shape.c_str(), "%ld, %ld", &m.rows, &m.cols) != 2)
+        die(path + ": unsupported shape '" + shape + "' (need 2-D)");
+    m.data.resize(static_cast<size_t>(m.rows) * m.cols);
+    if (std::fread(m.data.data(), 1, m.data.size(), f) != m.data.size())
+        die(path + ": truncated data");
+    std::fclose(f);
+    return m;
+}
+
+}  // namespace dos

@@ -1,0 +1,137 @@
+// Query-time algorithms: table-search and weighted A*.
+//
+// table-search (the distributed demo path, reference make_fifos.py:20):
+// iterated first-move lookup from s toward t, cost accumulated on the
+// possibly congestion-perturbed query weights while moves follow the
+// free-flow table (SURVEY.md §0). Counter/timer vocabulary matches the
+// response schema (reference process_query.py:198-213).
+//
+// A* (the hscale/fscale family implied by the reference's knobs,
+// args.py:30-57): point-to-point weighted A* on the query-time weights
+// with h = euclidean distance scaled by the graph's minimum cost-per-unit
+// (admissible for hscale <= 1). f = g + hscale * h; hscale > 1 trades
+// optimality for speed, fscale > 0 additionally prunes nodes whose f
+// exceeds (1 + fscale) * best-known goal cost. Emits the classic
+// priority-queue telemetry: n_expanded / n_inserted / n_touched /
+// n_updated / n_surplus.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <functional>
+#include <queue>
+#include <vector>
+
+#include "common.hpp"
+#include "graph.hpp"
+
+namespace dos {
+
+struct SearchStats {
+    int64_t n_expanded = 0, n_inserted = 0, n_touched = 0, n_updated = 0,
+            n_surplus = 0;
+    int64_t plen = 0;
+    int64_t finished = 0;
+
+    void operator+=(const SearchStats& o) {
+        n_expanded += o.n_expanded;
+        n_inserted += o.n_inserted;
+        n_touched += o.n_touched;
+        n_updated += o.n_updated;
+        n_surplus += o.n_surplus;
+        plen += o.plen;
+        finished += o.finished;
+    }
+};
+
+struct QueryResult {
+    int64_t cost = 0;
+    int64_t plen = 0;
+    bool finished = false;
+};
+
+// fm(x) -> slot toward the fixed target of this query
+inline QueryResult table_search(const Graph& g,
+                                const std::function<int8_t(int64_t)>& fm,
+                                int64_t s, int64_t t,
+                                const std::vector<int32_t>& w_query,
+                                int64_t k_moves = -1) {
+    QueryResult r;
+    int64_t x = s;
+    int64_t limit = k_moves < 0 ? g.n : k_moves;
+    while (x != t && r.plen < limit) {
+        int8_t slot = fm(x);
+        if (slot < 0) break;
+        int32_t e = g.out_edge_at(x, slot);
+        r.cost += w_query[e];
+        x = g.dst[e];
+        ++r.plen;
+    }
+    r.finished = (x == t);
+    return r;
+}
+
+// cost-per-coordinate-unit lower bound for the euclidean heuristic
+inline double min_cost_per_unit(const Graph& g,
+                                const std::vector<int32_t>& w) {
+    double best = 1e300;
+    for (int64_t e = 0; e < g.m; ++e) {
+        double dx = double(g.xs[g.src[e]] - g.xs[g.dst[e]]);
+        double dy = double(g.ys[g.src[e]] - g.ys[g.dst[e]]);
+        double len = std::sqrt(dx * dx + dy * dy);
+        if (len > 0) best = std::min(best, double(w[e]) / len);
+    }
+    return best == 1e300 ? 0.0 : best;
+}
+
+inline QueryResult astar(const Graph& g, int64_t s, int64_t t,
+                         const std::vector<int32_t>& w_query,
+                         double hscale, double fscale, SearchStats& stats,
+                         double cpu /* precomputed min_cost_per_unit */) {
+    auto h = [&](int64_t x) -> int64_t {
+        double dx = double(g.xs[x] - g.xs[t]);
+        double dy = double(g.ys[x] - g.ys[t]);
+        return int64_t(std::sqrt(dx * dx + dy * dy) * cpu * hscale);
+    };
+    std::vector<int64_t> gcost(g.n, INF);
+    std::vector<int64_t> parent_edge(g.n, -1);
+    using QE = std::pair<int64_t, int64_t>;  // (f, node)
+    std::priority_queue<QE, std::vector<QE>, std::greater<QE>> open;
+    gcost[s] = 0;
+    open.emplace(h(s), s);
+    stats.n_inserted++;
+    int64_t goal_cost = INF;
+    while (!open.empty()) {
+        auto [f, u] = open.top();
+        open.pop();
+        if (f > gcost[u] + h(u)) { stats.n_surplus++; continue; }
+        if (u == t) { goal_cost = gcost[u]; break; }
+        if (fscale > 0 && goal_cost < INF &&
+            f > int64_t((1.0 + fscale) * double(goal_cost)))
+            continue;
+        stats.n_expanded++;
+        for (int64_t p = g.out_ptr[u]; p < g.out_ptr[u + 1]; ++p) {
+            int32_t e = g.out_eid[p];
+            int64_t v = g.dst[e];
+            stats.n_touched++;
+            int64_t ng = gcost[u] + w_query[e];
+            if (ng < gcost[v]) {
+                if (gcost[v] < INF) stats.n_updated++;
+                gcost[v] = ng;
+                parent_edge[v] = e;
+                open.emplace(ng + h(v), v);
+                stats.n_inserted++;
+            }
+        }
+    }
+    QueryResult r;
+    r.finished = goal_cost < INF;
+    r.cost = r.finished ? goal_cost : 0;
+    if (r.finished)
+        for (int64_t x = t; x != s; x = g.src[parent_edge[x]]) ++r.plen;
+    stats.plen += r.plen;
+    stats.finished += r.finished ? 1 : 0;
+    return r;
+}
+
+}  // namespace dos
