@@ -66,25 +66,45 @@ def main() -> None:
     oracle = CPDOracle(g, dc, mesh=mesh)
 
     with Timer() as t_build:
-        oracle.build(chunk=chunk)
+        oracle.build(chunk=chunk, store_dists=True)
         jax.block_until_ready(oracle.fm)
     rows_per_s = g.n / t_build.interval
     log(f"CPD build: {t_build} ({rows_per_s:,.0f} target rows/s, "
         f"{g.n * g.n / t_build.interval / 1e9:.2f} G entries/s)")
 
-    # warm-up at the full scenario shape: compiles the query program once,
+    # congestion diff for the perturbed round (reference: one round/diff)
+    from distributed_oracle_search_tpu.data import synth_diff
+    dsrc, ddst, dw = synth_diff(g, frac=0.1, seed=2)
+    w_diff = g.weights_with_diff((dsrc, ddst, dw))
+
+    # warm-up at the full scenario shape: compiles each query program once,
     # like the reference's resident fifo_auto loading before the campaign
     with Timer() as t_compile:
         oracle.query(queries)
+        oracle.query(queries, w_query=w_diff)
+        oracle.query_dist(queries)
     log(f"query warm-up (compile): {t_compile}")
 
     with Timer() as t_scen:
         cost, plen, finished = oracle.query(queries)
     n_fin = int(finished.sum())
     qps = n_queries / t_scen.interval
-    log(f"scenario: {n_queries} queries in {t_scen} -> {qps:,.0f} q/s; "
+    log(f"walk free-flow: {n_queries} in {t_scen} -> {qps:,.0f} q/s; "
         f"finished {n_fin}/{n_queries}, mean plen {plen.mean():.1f}")
     assert n_fin == n_queries, "benchmark correctness gate failed"
+
+    with Timer() as t_diff:
+        cost_d, plen_d, fin_d = oracle.query(queries, w_query=w_diff)
+    assert int(fin_d.sum()) == n_queries
+    assert (cost_d >= cost).all(), "diffed costs must dominate free flow"
+    log(f"walk diffed:   {n_queries} in {t_diff} -> "
+        f"{n_queries / t_diff.interval:,.0f} q/s")
+
+    with Timer() as t_dist:
+        cost_g, fin_g = oracle.query_dist(queries)
+    assert (cost_g == cost).all(), "dist fast path must match the walk"
+    log(f"dist gather:   {n_queries} in {t_dist} -> "
+        f"{n_queries / t_dist.interval:,.0f} q/s")
 
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     print(json.dumps({
@@ -97,6 +117,8 @@ def main() -> None:
             "graph_edges": g.m,
             "n_queries": n_queries,
             "scenario_seconds": round(t_scen.interval, 4),
+            "diff_queries_per_sec": round(n_queries / t_diff.interval, 1),
+            "dist_queries_per_sec": round(n_queries / t_dist.interval, 1),
             "cpd_build_seconds": round(t_build.interval, 2),
             "cpd_rows_per_sec": round(rows_per_s, 1),
             "devices": len(devices),

@@ -32,13 +32,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..data.graph import Graph
+from ..data.graph import Graph, INF
 from ..ops import DeviceGraph
 from ..parallel.mesh import (
     make_mesh, worker_sharding, WORKER_AXIS, DATA_AXIS,
 )
 from ..parallel.partition import DistributionController
-from ..parallel.sharded import pad_targets, build_fm_sharded, query_sharded
+from ..parallel.sharded import (
+    pad_targets, build_fm_sharded, query_dist_sharded, query_sharded,
+)
 
 INDEX_VERSION = 1
 
@@ -142,13 +144,27 @@ class CPDOracle:
                 "one mesh shard per worker")
         self.dg = DeviceGraph.from_graph(graph)
         self.targets_wr = pad_targets(controller)
-        self.fm = None  # int8 [W, R, N], sharded on worker axis
+        self.fm = None     # int8 [W, R, N], sharded on worker axis
+        self.dists = None  # optional int32 [W, R, N] (build(store_dists=True))
 
     # ------------------------------------------------------------- build
-    def build(self, chunk: int = 0, max_iters: int = 0) -> "CPDOracle":
-        """Precompute all first-move rows, sharded over the mesh."""
-        self.fm = build_fm_sharded(self.dg, self.targets_wr, self.mesh,
-                                   chunk=chunk, max_iters=max_iters)
+    def build(self, chunk: int = 0, max_iters: int = 0,
+              store_dists: bool = False) -> "CPDOracle":
+        """Precompute all first-move rows, sharded over the mesh.
+
+        ``store_dists=True`` also keeps the converged distance table (4x
+        the fm memory) enabling :meth:`query_dist` — free-flow answers by
+        one gather instead of a path walk. Distances are free-flow only
+        and are not persisted by :meth:`save` (they are a pure derivative
+        of the graph; rebuild to get them back).
+        """
+        if store_dists:
+            self.fm, self.dists = build_fm_sharded(
+                self.dg, self.targets_wr, self.mesh, chunk=chunk,
+                max_iters=max_iters, with_dists=True)
+        else:
+            self.fm = build_fm_sharded(self.dg, self.targets_wr, self.mesh,
+                                       chunk=chunk, max_iters=max_iters)
         return self
 
     # ------------------------------------------------------- persistence
@@ -274,3 +290,28 @@ class CPDOracle:
         out_p[active] = plen[sd[active], sw[active], sq[active]]
         out_f[active] = fin[sd[active], sw[active], sq[active]]
         return out_c, out_p, out_f
+
+    def query_dist(self, queries: np.ndarray, active_worker: int = -1):
+        """Free-flow fast path: answer d(s → t) by one sharded gather.
+
+        Requires ``build(store_dists=True)``. Returns ``(cost, finished)``
+        — no ``plen`` (no path is materialized; that is the point:
+        distance-only answers need no extraction, SURVEY.md §5). Costs on
+        a diffed graph still need :meth:`query`.
+        """
+        if self.dists is None:
+            raise RuntimeError(
+                "distance table not resident; build(store_dists=True)")
+        r_arr, s_arr, t_arr, valid, scatter = self.route(
+            queries, active_worker)
+        cost = np.asarray(query_dist_sharded(self.dists, r_arr, s_arr,
+                                             self.mesh))
+        nq = len(queries)
+        active, sd, sw, sq = scatter
+        out_c = np.zeros(nq, np.int64)
+        out_f = np.zeros(nq, bool)
+        got = cost[sd[active], sw[active], sq[active]]
+        fin = got < int(INF)
+        out_c[active] = np.where(fin, got, 0)
+        out_f[active] = fin
+        return out_c, out_f

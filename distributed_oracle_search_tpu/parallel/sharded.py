@@ -54,24 +54,36 @@ def pad_targets(controller, dtype=np.int32) -> np.ndarray:
 # --------------------------------------------------------------------- build
 
 @functools.lru_cache(maxsize=None)
-def _build_fn(mesh: Mesh, n_workers: int, max_iters: int):
+def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
+              with_dists: bool):
+    from ..ops.bellman_ford import dist_to_targets, first_move_from_dist
+
     tgt_shard = NamedSharding(mesh, P(None, WORKER_AXIS))
     out_shard = NamedSharding(mesh, P(WORKER_AXIS, None, None))
+    outs = (out_shard, out_shard) if with_dists else out_shard
 
     @functools.partial(jax.jit, in_shardings=(replicated(mesh), tgt_shard),
-                       out_shardings=out_shard)
+                       out_shardings=outs)
     def _build(dg, tgt_bw):
         # tgt_bw: [B, W] — worker on the minor axis so each device owns a
         # column; transpose+flatten into the row-sharded batch
-        fm = build_fm_columns(dg, tgt_bw.T.reshape(-1), max_iters=max_iters)
-        return fm.reshape(n_workers, -1, dg.n)
+        tgts = tgt_bw.T.reshape(-1)
+        if not with_dists:
+            fm = build_fm_columns(dg, tgts, max_iters=max_iters)
+            return fm.reshape(n_workers, -1, dg.n)
+        # dists requested: run the same two stages build_fm_columns
+        # composes, keeping the intermediate
+        dist = dist_to_targets(dg, tgts, max_iters=max_iters)
+        fm = first_move_from_dist(dg, tgts, dist)
+        return (fm.reshape(n_workers, -1, dg.n),
+                dist.reshape(n_workers, -1, dg.n))
 
     return _build
 
 
 def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
                      mesh: Mesh, chunk: int = 0,
-                     max_iters: int = 0) -> jax.Array:
+                     max_iters: int = 0, with_dists: bool = False):
     """Build the full sharded CPD: int8 [W, R, N], axis 0 on ``worker``.
 
     ``chunk`` bounds per-device live distance rows (0 = whole shard at
@@ -79,13 +91,18 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
     device only ever materializes ``[chunk, N]`` int32 distances, then
     concatenates the int8 results — the memory staging the reference gets
     from per-block CPD files (``README.md:92``).
+
+    ``with_dists=True`` additionally returns the converged distance table
+    int32 [W, R, N] (4x the fm memory): free-flow queries then need no
+    walk at all — one gather answers d(s→t) (SURVEY.md §5: "distance-only
+    answers need no extraction").
     """
     w, r = targets_wr.shape
     if mesh.shape[WORKER_AXIS] != w:
         raise ValueError(
             f"targets rows ({w}) != mesh worker axis "
             f"({mesh.shape[WORKER_AXIS]})")
-    build = _build_fn(mesh, w, max_iters)
+    build = _build_fn(mesh, w, max_iters, with_dists)
     if chunk <= 0 or chunk >= r:
         chunks = [targets_wr]
     else:
@@ -98,11 +115,45 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
         chunks = [targets_wr[:, i:i + chunk]
                   for i in range(0, targets_wr.shape[1], chunk)]
     parts = [build(dg, jnp.asarray(c.T)) for c in chunks]
+    if with_dists:
+        fms, dists = zip(*parts)
+        fm = fms[0] if len(fms) == 1 else jnp.concatenate(fms, axis=1)
+        dist = (dists[0] if len(dists) == 1
+                else jnp.concatenate(dists, axis=1))
+        return fm[:, :r], dist[:, :r]
     fm = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
     return fm[:, :r]
 
 
 # --------------------------------------------------------------------- query
+
+@functools.lru_cache(maxsize=None)
+def _query_dist_fn(mesh: Mesh):
+    q3 = P(DATA_AXIS, WORKER_AXIS, None)
+
+    def _local(dist_local, rows, s):
+        # dist_local [1, R, N]; rows/s [D/|data|, 1, Q]
+        shape = s.shape
+        cost = dist_local[0][rows.reshape(-1), s.reshape(-1)]
+        return cost.reshape(shape)
+
+    sm = jax.shard_map(_local, mesh=mesh,
+                       in_specs=(P(WORKER_AXIS, None, None), q3, q3),
+                       out_specs=q3)
+    return jax.jit(sm)
+
+
+def query_dist_sharded(dist_wrn: jax.Array, t_rows: np.ndarray,
+                       s: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Free-flow fast path: d(s → t) by one sharded gather, no walk.
+
+    Inputs ``[D, W, Q]`` as in :func:`query_sharded`; returns cost
+    ``[D, W, Q]`` (INF where unreachable).
+    """
+    qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
+    rows_d, s_d = (jax.device_put(jnp.asarray(a), qs) for a in (t_rows, s))
+    return _query_dist_fn(mesh)(dist_wrn, rows_d, s_d)
+
 
 @functools.lru_cache(maxsize=None)
 def _query_fn(mesh: Mesh, max_steps: int):

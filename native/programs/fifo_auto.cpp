@@ -78,9 +78,15 @@ struct Server {
         : g(std::move(gg)), dc(std::move(dcc)), shard(std::move(sh)),
           wid(w), fifo_path(std::move(fifo)), alg(std::move(algo)) {}
 
+    std::vector<int32_t> scratch_weights;  // no_cache loads live here
+
     const std::vector<int32_t>& weights_for(const std::string& diff,
                                             bool no_cache) {
-        if (no_cache) weight_cache.clear();
+        if (no_cache) {  // python engine parity: clear AND don't cache
+            weight_cache.clear();
+            scratch_weights = weights_with_diff(g, diff);
+            return scratch_weights;
+        }
         auto it = weight_cache.find(diff);
         if (it != weight_cache.end()) return it->second;
         return weight_cache.emplace(diff, weights_with_diff(g, diff))
@@ -100,9 +106,12 @@ struct Server {
         const std::vector<int32_t>& wq = weights_for(difffile, no_cache);
         auto queries = load_query_file(queryfile);
         // routing invariant (same loud failure as the Python ShardEngine):
-        // every query's target must be owned by this worker
+        // every query's target must be owned by this worker, and both
+        // endpoints must be in range (a corrupt query file must answer
+        // FAIL, not index out of bounds)
         for (auto& [s, t] : queries) {
-            (void)s;
+            if (s < 0 || s >= dc.nodenum)
+                die("query source " + std::to_string(s) + " out of range");
             if (t < 0 || t >= dc.nodenum || dc.wid_of[t] != wid)
                 die("routing invariant violated: query targets node " +
                     std::to_string(t) + " not owned by worker " +

@@ -22,26 +22,36 @@ struct Int8Matrix {
     int8_t at(int64_t r, int64_t c) const { return data[r * cols + c]; }
 };
 
-inline void npy_write_i8(const std::string& path, const Int8Matrix& m) {
+inline void npy_write_i8_fd(FILE* f, const Int8Matrix& m) {
     std::string header = "{'descr': '|i1', 'fortran_order': False, "
                          "'shape': (" + std::to_string(m.rows) + ", " +
                          std::to_string(m.cols) + "), }";
-    // pad header so that magic(6)+ver(2)+len(2)+header is a multiple of 64
     size_t base = 6 + 2 + 2;
-    size_t total = base + header.size() + 1;  // +1 for '\n'
+    size_t total = base + header.size() + 1;
     size_t pad = (64 - total % 64) % 64;
     header.append(pad, ' ');
     header.push_back('\n');
-    FILE* f = std::fopen(path.c_str(), "wb");
-    if (!f) die("cannot write " + path);
     const unsigned char magic[8] = {0x93, 'N', 'U', 'M', 'P', 'Y', 1, 0};
     std::fwrite(magic, 1, 8, f);
     uint16_t hlen = static_cast<uint16_t>(header.size());
     std::fwrite(&hlen, 2, 1, f);
     std::fwrite(header.data(), 1, header.size(), f);
     std::fwrite(m.data.data(), 1, m.data.size(), f);
-    std::fclose(f);
 }
+
+// Atomic write: temp file + rename, so a build killed mid-write never
+// leaves a truncated block that a later resume would treat as complete.
+inline void npy_write_i8(const std::string& path, const Int8Matrix& m) {
+    std::string tmp = path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) die("cannot write " + tmp);
+    npy_write_i8_fd(f, m);
+    bool ok = std::fflush(f) == 0;
+    ok = (std::fclose(f) == 0) && ok;
+    if (!ok || std::rename(tmp.c_str(), path.c_str()) != 0)
+        die("cannot finalize " + path);
+}
+
 
 inline Int8Matrix npy_read_i8(const std::string& path) {
     FILE* f = std::fopen(path.c_str(), "rb");
@@ -71,9 +81,9 @@ inline Int8Matrix npy_read_i8(const std::string& path) {
     size_t ep = header.find(')', sp);
     std::string shape = header.substr(sp + 1, ep - sp - 1);
     Int8Matrix m;
-    if (std::sscanf(shape.c_str(), "%ld , %ld", &m.rows, &m.cols) != 2 &&
-        std::sscanf(shape.c_str(), "%ld ,%ld", &m.rows, &m.cols) != 2 &&
-        std::sscanf(shape.c_str(), "%ld, %ld", &m.rows, &m.cols) != 2)
+    // a space in the scanf format matches any run of whitespace, so this
+    // accepts "60,80", "60, 80", "60 , 80", ...
+    if (std::sscanf(shape.c_str(), "%ld , %ld", &m.rows, &m.cols) != 2)
         die(path + ": unsupported shape '" + shape + "' (need 2-D)");
     m.data.resize(static_cast<size_t>(m.rows) * m.cols);
     if (std::fread(m.data.data(), 1, m.data.size(), f) != m.data.size())

@@ -130,3 +130,36 @@ def test_data_axis_mesh(toy_graph, toy_queries):
     for i in range(0, len(toy_queries), 9):
         s, t = map(int, toy_queries[i])
         assert cost[i] == dist_to_target(toy_graph, t)[s]
+
+
+def test_query_dist_fast_path(toy_graph, toy_queries):
+    """build(store_dists=True) -> free-flow answers by one gather, equal
+    to the walked costs and the CPU oracle."""
+    from distributed_oracle_search_tpu.models.cpd import CPDOracle
+    from distributed_oracle_search_tpu.models.reference import dist_to_target
+    from distributed_oracle_search_tpu.parallel import DistributionController
+    from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+
+    dc = DistributionController("tpu", None, 4, toy_graph.n)
+    oracle = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=4))
+    oracle.build(store_dists=True)
+    cost_d, fin_d = oracle.query_dist(toy_queries)
+    cost_w, _, fin_w = oracle.query(toy_queries)
+    assert fin_d.all() and (fin_d == fin_w).all()
+    assert (cost_d == cost_w).all()
+    s0, t0 = map(int, toy_queries[3])
+    assert cost_d[3] == dist_to_target(toy_graph, t0)[s0]
+
+
+def test_query_dist_requires_store(toy_graph, toy_queries):
+    import pytest as _pytest
+
+    from distributed_oracle_search_tpu.models.cpd import CPDOracle
+    from distributed_oracle_search_tpu.parallel import DistributionController
+    from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+
+    dc = DistributionController("tpu", None, 2, toy_graph.n)
+    oracle = CPDOracle(toy_graph, dc,
+                       mesh=make_mesh(n_workers=2)).build()
+    with _pytest.raises(RuntimeError, match="store_dists"):
+        oracle.query_dist(toy_queries)
