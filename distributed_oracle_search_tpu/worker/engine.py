@@ -50,19 +50,26 @@ def load_shard_rows(outdir: str, wid: int) -> np.ndarray:
 
 class ShardEngine:
     def __init__(self, graph: Graph, dc: DistributionController, wid: int,
-                 outdir: str):
+                 outdir: str, alg: str = "table-search"):
         import jax.numpy as jnp
         from ..ops import DeviceGraph
 
+        if alg not in ("table-search", "astar"):
+            raise ValueError(f"unknown algorithm {alg!r}")
+        self.alg = alg
         self.graph = graph
         self.dc = dc
         self.wid = wid
-        self.fm = jnp.asarray(load_shard_rows(outdir, wid))
-        owned = dc.owned(wid)
-        if len(owned) != self.fm.shape[0]:
-            raise ValueError(
-                f"shard w{wid}: {self.fm.shape[0]} CPD rows but controller "
-                f"owns {len(owned)} nodes — partition mismatch")
+        if alg == "table-search":  # astar needs no first-move shard
+            self.fm = jnp.asarray(load_shard_rows(outdir, wid))
+            owned = dc.owned(wid)
+            if len(owned) != self.fm.shape[0]:
+                raise ValueError(
+                    f"shard w{wid}: {self.fm.shape[0]} CPD rows but "
+                    f"controller owns {len(owned)} nodes — partition "
+                    "mismatch")
+        else:
+            self.fm = None
         self.dg = DeviceGraph.from_graph(graph)
         self._weight_cache: dict[str, object] = {}
 
@@ -116,6 +123,18 @@ class ShardEngine:
                 "workers — routing invariant violated")
 
         t1 = time.perf_counter()
+        if self.alg == "astar":
+            deadline = t1 + config.time / 1e9 if config.time else None
+            for _ in range(max(config.itrs, 1)):
+                cost, plen, fin, counters = self._answer_astar(
+                    queries, config, difffile)
+                if deadline is not None and time.perf_counter() > deadline:
+                    break
+            t2 = time.perf_counter()
+            stats = StatsRow(
+                **counters, t_receive=t1 - t0, t_astar=t2 - t1,
+                t_search=t2 - t0)
+            return cost, plen, fin, stats
         deadline = t1 + config.time / 1e9 if config.time else None
         for _ in range(max(config.itrs, 1)):
             cost, plen, fin = table_search_batch(
@@ -140,3 +159,47 @@ class ShardEngine:
             t_search=t2 - t0,
         )
         return cost, plen, fin, stats
+
+    def _raw_weights_for(self, difffile: str, no_cache: bool):
+        """Raw (unpadded) query weights + heuristic scale, cached per diff
+        like the device-side weight cache."""
+        from ..models.astar import min_cost_per_unit
+
+        key = ("raw", difffile)
+        if key in self._weight_cache and not no_cache:
+            return self._weight_cache[key]
+        w = (self.graph.w if difffile == "-"
+             else self.graph.weights_with_diff(read_diff(difffile)))
+        entry = (w, min_cost_per_unit(self.graph, w))
+        if no_cache:
+            self._weight_cache.pop(key, None)
+        else:
+            self._weight_cache[key] = entry
+        return entry
+
+    def _answer_astar(self, queries: np.ndarray, config: RuntimeConfig,
+                      difffile: str = "-"):
+        """hscale/fscale weighted A* per query on the CPU oracle (parity
+        with the native server's ``--alg astar``).
+
+        Honors ``hscale``/``fscale``/``itrs``/``time``/``no_cache``.
+        ``k_moves`` is deliberately NOT applied: per the reference,
+        "K-moves are only available with extractions while hScale only
+        influences A*" (reference ``args.py:28``).
+        """
+        from ..models.astar import AstarStats, astar
+
+        w, cpu = self._raw_weights_for(difffile, config.no_cache)
+        st = AstarStats()
+        cost = np.zeros(len(queries), np.int64)
+        plen = np.zeros(len(queries), np.int64)
+        fin = np.zeros(len(queries), bool)
+        for i, (s, t) in enumerate(queries):
+            cost[i], plen[i], fin[i] = astar(
+                self.graph, int(s), int(t), w, hscale=config.hscale,
+                fscale=config.fscale, cpu=cpu, stats=st)
+        counters = dict(
+            n_expanded=st.n_expanded, n_inserted=st.n_inserted,
+            n_touched=st.n_touched, n_updated=st.n_updated,
+            n_surplus=st.n_surplus, plen=st.plen, finished=st.finished)
+        return cost, plen, fin, counters

@@ -39,14 +39,15 @@ STOP_TOKEN = "__DOS_STOP__"
 
 class FifoServer:
     def __init__(self, conf: ClusterConfig, wid: int,
-                 command_fifo: str | None = None):
+                 command_fifo: str | None = None,
+                 alg: str = "table-search"):
         self.conf = conf
         self.wid = wid
         self.command_fifo = command_fifo or command_fifo_path(wid)
         graph = Graph.from_xy(conf.xy_file)
         dc = DistributionController(conf.partmethod, conf.partkey,
                                     conf.maxworker, graph.n)
-        self.engine = ShardEngine(graph, dc, wid, conf.outdir)
+        self.engine = ShardEngine(graph, dc, wid, conf.outdir, alg=alg)
         # preload the first diff's weights like the reference server does
         # (make_fifos.py:18 loads only diffs[0])
         if conf.diffs:
@@ -132,12 +133,18 @@ def main(argv=None) -> int:
     p.add_argument("-w", "--workerid", type=int, required=True)
     p.add_argument("--fifo", default=None,
                    help="command FIFO path override")
+    p.add_argument("--alg", default="table-search",
+                   choices=["table-search", "astar"],
+                   help="serving algorithm (reference hard-codes "
+                        "table-search, make_fifos.py:20; astar serves the "
+                        "hscale/fscale family)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
     set_verbosity(args.verbose)
 
     conf = ClusterConfig.load(args.c)
-    server = FifoServer(conf, args.workerid, command_fifo=args.fifo)
+    server = FifoServer(conf, args.workerid, command_fifo=args.fifo,
+                        alg=args.alg)
     server.serve_forever()
     return 0
 

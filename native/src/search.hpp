@@ -106,9 +106,13 @@ inline QueryResult astar(const Graph& g, int64_t s, int64_t t,
         open.pop();
         if (f > gcost[u] + h(u)) { stats.n_surplus++; continue; }
         if (u == t) { goal_cost = gcost[u]; break; }
-        if (fscale > 0 && goal_cost < INF &&
-            f > int64_t((1.0 + fscale) * double(goal_cost)))
+        // fscale prune against the incumbent: gcost[t] is live as soon as
+        // any relaxation reaches t, before t is ever popped
+        if (fscale > 0 && gcost[t] < INF &&
+            f > int64_t((1.0 + fscale) * double(gcost[t]))) {
+            stats.n_surplus++;
             continue;
+        }
         stats.n_expanded++;
         for (int64_t p = g.out_ptr[u]; p < g.out_ptr[u + 1]; ++p) {
             int32_t e = g.out_eid[p];

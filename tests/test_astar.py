@@ -1,0 +1,161 @@
+"""A*-family: optimality, telemetry, engine wiring, native parity.
+
+The hscale/fscale weighted-A* family implied by the reference's knobs
+(reference ``args.py:30-57``) with the priority-queue counter vocabulary of
+its response schema (``process_query.py:198-213``).
+"""
+
+import os
+import shutil
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.cli import process_query as pq
+from distributed_oracle_search_tpu.cli.args import parse_args
+from distributed_oracle_search_tpu.data import (
+    Graph, ensure_synth_dataset, read_scen, synth_scenario,
+)
+from distributed_oracle_search_tpu.models import (
+    AstarStats, astar, dijkstra, min_cost_per_unit,
+)
+from distributed_oracle_search_tpu.models.reference import dist_to_target
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    datadir = str(tmp_path_factory.mktemp("adata"))
+    return ensure_synth_dataset(datadir, width=9, height=7, n_queries=48,
+                                seed=41)
+
+
+@pytest.fixture(scope="module")
+def graph(dataset):
+    return Graph.from_xy(dataset["xy"])
+
+
+def test_astar_optimal_at_hscale_1(graph):
+    """hscale=1 euclidean×min-cost-per-unit is admissible -> optimal."""
+    qs = synth_scenario(graph.n, 40, seed=42)
+    for s, t in qs:
+        cost, plen, fin = astar(graph, int(s), int(t))
+        assert fin
+        assert cost == dijkstra(graph, int(s))[int(t)]
+        assert plen > 0
+
+
+def test_astar_counters_live(graph):
+    st = AstarStats()
+    astar(graph, 0, graph.n - 1, stats=st)
+    assert st.n_expanded > 0
+    assert st.n_inserted > st.n_expanded * 0  # pushes happened
+    assert st.n_touched >= st.n_expanded      # every expansion touches edges
+    assert st.finished == 1
+
+
+def test_astar_hscale_inflation_reduces_expansions(graph):
+    s, t = 0, graph.n - 1
+    st1, st3 = AstarStats(), AstarStats()
+    c1, _, _ = astar(graph, s, t, hscale=1.0, stats=st1)
+    c3, _, _ = astar(graph, s, t, hscale=3.0, stats=st3)
+    assert st3.n_expanded <= st1.n_expanded   # greedier -> fewer pops
+    assert c3 >= c1                           # possibly suboptimal
+
+
+def test_astar_diffed_weights(graph, dataset):
+    from distributed_oracle_search_tpu.data import read_diff
+    w = graph.weights_with_diff(read_diff(dataset["diff"]))
+    s, t = 1, graph.n - 2
+    cost, _, fin = astar(graph, s, t, w)
+    assert fin
+    assert cost == dijkstra(graph, s, w)[t]
+
+
+def test_min_cost_per_unit_admissible(graph):
+    cpu = min_cost_per_unit(graph)
+    assert cpu > 0
+    dx = graph.xs[graph.src] - graph.xs[graph.dst]
+    dy = graph.ys[graph.src] - graph.ys[graph.dst]
+    assert (graph.w >= cpu * np.hypot(dx, dy) - 1e-6).all()
+
+
+def test_shard_engine_astar(dataset, graph, tmp_path):
+    """ShardEngine(alg=astar): optimal costs + full counters on the wire
+    row; no CPD shard required."""
+    from distributed_oracle_search_tpu.parallel.partition import (
+        DistributionController,
+    )
+    from distributed_oracle_search_tpu.worker import ShardEngine
+
+    dc = DistributionController("mod", 1, 1, graph.n)
+    eng = ShardEngine(graph, dc, wid=0, outdir=str(tmp_path), alg="astar")
+    queries = read_scen(dataset["scen"])[:12]
+    args = parse_args(["--h-scale", "1.0"])
+    cost, plen, fin, stats = eng.answer(queries, pq.runtime_config(args))
+    assert fin.all() and stats.finished == len(queries)
+    assert stats.n_expanded > 0 and stats.n_inserted > 0
+    for (s, t), c in zip(queries, cost):
+        assert c == dist_to_target(graph, int(t))[int(s)]
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_astar_counter_parity(dataset, graph, tmp_path):
+    """Native --alg astar and the Python A* agree on finished counts and
+    produce comparable telemetry on the same batch."""
+    from distributed_oracle_search_tpu.transport.fifo import send
+    from distributed_oracle_search_tpu.transport.wire import (
+        Request, RuntimeConfig, write_query_file,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run(["make", "-C", os.path.join(repo, "native"), "fast",
+                    "-j4"], check=True, capture_output=True)
+    fifo_auto = os.path.join(repo, "native", "build", "fast", "bin",
+                             "fifo_auto")
+    fifo = str(tmp_path / "na.fifo")
+    proc = subprocess.Popen(
+        [fifo_auto, "--input", dataset["xy"], "--partmethod", "mod",
+         "--partkey", "1", "--workerid", "0", "--maxworker", "1",
+         "--outdir", str(tmp_path), "--alg", "astar", "--fifo", fifo],
+        stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 15
+        while not os.path.exists(fifo):
+            assert time.time() < deadline
+            time.sleep(0.05)
+        queries = read_scen(dataset["scen"])[:12]
+        qfile = str(tmp_path / "q")
+        write_query_file(qfile, queries)
+        req = Request(RuntimeConfig(hscale=1.0), qfile,
+                      str(tmp_path / "a.fifo"))
+        row = send("localhost", req, fifo, timeout=60)
+        assert row.ok and row.finished == len(queries)
+
+        # python side, same batch
+        from distributed_oracle_search_tpu.models import AstarStats
+        st = AstarStats()
+        for s, t in queries:
+            astar(graph, int(s), int(t), stats=st)
+        assert st.finished == row.finished
+        assert st.plen == row.plen       # both optimal & same tie landscape
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def test_astar_fscale_correct_under_inflation(graph):
+    """fscale prunes only pops beyond (1+fscale)x the incumbent — results
+    stay finished and no worse than the unpruned inflated search."""
+    qs = synth_scenario(graph.n, 20, seed=44)
+    for s, t in qs:
+        c_plain, _, f_plain = astar(graph, int(s), int(t), hscale=3.0)
+        c_pruned, _, f_pruned = astar(graph, int(s), int(t), hscale=3.0,
+                                      fscale=0.1)
+        assert f_plain and f_pruned
+        opt = dijkstra(graph, int(s))[int(t)]
+        assert c_pruned >= opt
+        # pruning cannot make the answer worse than the admissible bound
+        assert c_pruned <= (1.0 + 0.1) * c_plain + 1
