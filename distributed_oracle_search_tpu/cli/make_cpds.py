@@ -115,9 +115,21 @@ def run_host(conf: ClusterConfig, args) -> None:
 
 
 def main(argv=None) -> int:
+    import os
+
     args = parse_args(argv, prog="make_cpds")
     set_verbosity(args.verbose)
-    conf = test_config() if args.test else ClusterConfig.load(args.c)
+    if args.test:
+        import jax
+
+        from ..data.synth import ensure_synth_dataset
+
+        # size the canned config to the local device count, like
+        # process_query's test mode — the two must build/read the same index
+        conf = test_config(n_workers=len(jax.devices()))
+        ensure_synth_dataset(os.path.dirname(conf.xy_file) or "./data")
+    else:
+        conf = ClusterConfig.load(args.c)
     if args.backend == "tpu" or (args.backend == "auto" and conf.is_tpu):
         run_tpu(conf, args)
     else:

@@ -170,6 +170,69 @@ class Graph:
             w[self.edge_ids(dsrc, ddst)] = dw
         return w
 
+    # ---------------------------------------------------------------- shift
+    def shift_split(self, max_shifts: int = 64):
+        """Split edges into shift-structured + leftover sets for the
+        gather-free relaxation (``ops.shift_relax``).
+
+        Road-network node ids laid out with locality (grid row-major, or
+        RCM/BFS orderings) put most edges at a few constant id-offsets
+        ``dst - src``. For those, min-plus relaxation needs no gather at
+        all: it is a shifted add + min, pure VPU work. The remaining
+        edges fall back to a (small) padded ELL gather.
+
+        Returns ``(shifts, w_shift, nbr_left, w_left)``:
+
+        * ``shifts``  tuple of ints, the kept offsets (≤ ``max_shifts``,
+          most-frequent first),
+        * ``w_shift`` int32 ``[S, N]``: weight of edge ``u → u+shifts[s]``
+          (min over parallel edges; INF where absent),
+        * ``nbr_left``/``w_left`` int32 ``[N, K_left]`` padded ELL of the
+          uncovered edges (``K_left`` may be 0 → empty arrays).
+
+        Free-flow weights only — this feeds the CPD build, which is always
+        free-flow (reference semantics).
+        """
+        delta = self.dst - self.src
+        vals, counts = np.unique(delta, return_counts=True)
+        # magnitude cap: the relaxation pads the distance array by
+        # max|shift| rows every iteration, so one frequent long-range
+        # offset must not be allowed to blow up the working set — beyond
+        # n/8 an offset goes to the leftover gather instead. The floor
+        # keeps small graphs (where even the full width is cheap) intact.
+        cap = max(256, self.n // 8)
+        ok = np.abs(vals) <= cap
+        vals, counts = vals[ok], counts[ok]
+        keep = vals[np.argsort(-counts)[:max_shifts]]
+        shifts = tuple(int(s) for s in keep)
+        w_shift = np.full((len(shifts), self.n), int(INF), np.int32)
+        covered = np.zeros(self.m, bool)
+        for si, s in enumerate(shifts):
+            mask = delta == s
+            np.minimum.at(w_shift[si], self.src[mask], self.w[mask])
+            covered |= mask
+        src_l = self.src[~covered]
+        dst_l = self.dst[~covered]
+        w_l = self.w[~covered]
+        deg = np.bincount(src_l, minlength=self.n)
+        k_left = int(deg.max()) if len(src_l) else 0
+        nbr_left = np.repeat(np.arange(self.n, dtype=np.int32)[:, None],
+                             max(k_left, 1), axis=1)
+        w_left = np.full((self.n, max(k_left, 1)), int(INF), np.int32)
+        if len(src_l):
+            order = np.argsort(src_l, kind="stable")
+            starts = np.cumsum(np.concatenate([[0], deg[:-1]]))
+            slot = np.arange(len(src_l)) - np.repeat(starts, deg)
+            nbr_left[src_l[order], slot] = dst_l[order].astype(np.int32)
+            # parallel uncovered edges to the same dst would collide in the
+            # ELL slot only if they shared (src, slot); distinct slots keep
+            # them separate, min falls out of the relaxation itself
+            w_left[src_l[order], slot] = w_l[order]
+        if k_left == 0:
+            nbr_left = nbr_left[:, :0]
+            w_left = w_left[:, :0]
+        return shifts, w_shift, nbr_left, w_left
+
     # ----------------------------------------------------------------- io
     @classmethod
     def from_xy(cls, path: str) -> "Graph":

@@ -49,23 +49,48 @@ def shard_block_name(wid: int, bid: int) -> str:
     return f"cpd-w{wid:05d}-b{bid:05d}.npy"
 
 
+#: shift coverage below which auto falls back to the ELL gather relaxation
+SHIFT_COVERAGE_MIN = 0.9
+
+
+def pick_shift_graph(graph: Graph, method: str = "auto"):
+    """Resolve the build-method knob to an optional ShiftGraph.
+
+    The coverage decision happens on the host-side split arrays — graphs
+    that fall back to ELL never pay a device transfer.
+    """
+    from ..ops.shift_relax import ShiftGraph, split_coverage
+
+    if method not in ("auto", "ell", "shift"):
+        raise ValueError(f"unknown build method {method!r}")
+    if method == "ell":
+        return None
+    shifts, w_shift, nbr_left, w_left = graph.shift_split()
+    if method == "auto" and split_coverage(w_shift,
+                                           w_left) < SHIFT_COVERAGE_MIN:
+        return None
+    return ShiftGraph(shifts, w_shift, nbr_left, w_left, graph.n)
+
+
 def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
                        outdir: str, chunk: int = 0, max_iters: int = 0,
-                       resume: bool = True) -> list[str]:
+                       resume: bool = True,
+                       method: str = "auto") -> list[str]:
     """Build and persist ONE worker's CPD block files on the local device.
 
     This is the host-mode build unit: the reference launches one
     ``make_cpd_auto`` per worker over ssh/tmux (``make_cpds.py:20-21``), each
     emitting per-block CPD files; here one process builds its worker's rows
-    block-by-block with the batched min-plus kernel and writes
+    block-by-block with the batched min-plus kernel (gather-free shift
+    relaxation when the id layout allows) and writes
     ``cpd-w<wid>-b<bid>.npy`` per block. ``resume=True`` skips blocks whose
     file already exists — mid-build restart granularity the reference lacks
     (SURVEY.md §5 checkpoint/resume).
     """
     from ..ops import build_fm_columns
+    from ..ops.shift_relax import build_fm_columns_shift
 
     os.makedirs(outdir, exist_ok=True)
-    dg = DeviceGraph.from_graph(graph)
     owned = dc.owned(wid)
     bs = dc.block_size
     step = chunk if chunk > 0 else max(len(owned), 1)
@@ -78,6 +103,10 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     missing = [bid for bid in range(n_blocks)
                if not (resume and os.path.exists(
                    os.path.join(outdir, shard_block_name(wid, bid))))]
+    if not missing:
+        return []
+    sg = pick_shift_graph(graph, method)
+    dg = DeviceGraph.from_graph(graph)
     written = []
     per_step = step // bs
     for g0 in range(0, len(missing), per_step):
@@ -87,8 +116,12 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         tgts = np.concatenate(blocks)
         pad = np.full(step, -1, np.int32)  # fixed shape -> one compile
         pad[:len(tgts)] = tgts
-        fm = np.asarray(build_fm_columns(dg, jnp.asarray(pad),
-                                         max_iters=max_iters))
+        if sg is not None:
+            fm = np.asarray(build_fm_columns_shift(dg, sg, pad,
+                                                   max_iters=max_iters))
+        else:
+            fm = np.asarray(build_fm_columns(dg, jnp.asarray(pad),
+                                             max_iters=max_iters))
         off = 0
         for bid, blk in zip(group, blocks):
             fname = shard_block_name(wid, bid)
@@ -149,7 +182,8 @@ class CPDOracle:
 
     # ------------------------------------------------------------- build
     def build(self, chunk: int = 0, max_iters: int = 0,
-              store_dists: bool = False) -> "CPDOracle":
+              store_dists: bool = False,
+              method: str = "auto") -> "CPDOracle":
         """Precompute all first-move rows, sharded over the mesh.
 
         ``store_dists=True`` also keeps the converged distance table (4x
@@ -157,14 +191,21 @@ class CPDOracle:
         one gather instead of a path walk. Distances are free-flow only
         and are not persisted by :meth:`save` (they are a pure derivative
         of the graph; rebuild to get them back).
+
+        ``method``: ``"shift"`` forces the gather-free shift relaxation,
+        ``"ell"`` the padded-ELL gather relaxation, ``"auto"`` picks shift
+        when the graph's id layout puts ≥90% of edges on constant offsets
+        (:func:`pick_shift_graph`).
         """
+        sg = pick_shift_graph(self.graph, method)
         if store_dists:
             self.fm, self.dists = build_fm_sharded(
                 self.dg, self.targets_wr, self.mesh, chunk=chunk,
-                max_iters=max_iters, with_dists=True)
+                max_iters=max_iters, with_dists=True, sg=sg)
         else:
             self.fm = build_fm_sharded(self.dg, self.targets_wr, self.mesh,
-                                       chunk=chunk, max_iters=max_iters)
+                                       chunk=chunk, max_iters=max_iters,
+                                       sg=sg)
         return self
 
     # ------------------------------------------------------- persistence

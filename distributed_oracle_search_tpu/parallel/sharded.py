@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import DeviceGraph, build_fm_columns, table_search_batch
+from ..ops import DeviceGraph, table_search_batch
 from .mesh import WORKER_AXIS, DATA_AXIS, replicated
 
 
@@ -55,35 +55,52 @@ def pad_targets(controller, dtype=np.int32) -> np.ndarray:
 
 @functools.lru_cache(maxsize=None)
 def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
-              with_dists: bool):
+              with_dists: bool, shift_sig: tuple | None = None):
+    """One compiled sharded builder for both relaxation kernels.
+
+    ``shift_sig = (shifts, n, k_left)`` switches the distance stage to the
+    gather-free shift relaxation (extra replicated operands); None uses
+    the padded-ELL gather. Everything else — shardings, target layout,
+    first-move extraction, with_dists outputs — is shared, so the two
+    paths cannot drift.
+    """
     from ..ops.bellman_ford import dist_to_targets, first_move_from_dist
+    from ..ops.shift_relax import _dist_fn
 
     tgt_shard = NamedSharding(mesh, P(None, WORKER_AXIS))
     out_shard = NamedSharding(mesh, P(WORKER_AXIS, None, None))
+    rep = replicated(mesh)
     outs = (out_shard, out_shard) if with_dists else out_shard
+    n_shift_ops = 3 if shift_sig is not None else 0
+    shift_dist = (_dist_fn(*shift_sig, max_iters)
+                  if shift_sig is not None else None)
 
-    @functools.partial(jax.jit, in_shardings=(replicated(mesh), tgt_shard),
-                       out_shardings=outs)
-    def _build(dg, tgt_bw):
+    @functools.partial(
+        jax.jit,
+        in_shardings=(rep, *([rep] * n_shift_ops), tgt_shard),
+        out_shardings=outs)
+    def _build(dg, *ops_and_tgt):
+        *shift_ops, tgt_bw = ops_and_tgt
         # tgt_bw: [B, W] — worker on the minor axis so each device owns a
         # column; transpose+flatten into the row-sharded batch
         tgts = tgt_bw.T.reshape(-1)
-        if not with_dists:
-            fm = build_fm_columns(dg, tgts, max_iters=max_iters)
-            return fm.reshape(n_workers, -1, dg.n)
-        # dists requested: run the same two stages build_fm_columns
-        # composes, keeping the intermediate
-        dist = dist_to_targets(dg, tgts, max_iters=max_iters)
+        if shift_dist is not None:
+            dist = shift_dist(*shift_ops, tgts)
+        else:
+            dist = dist_to_targets(dg, tgts, max_iters=max_iters)
         fm = first_move_from_dist(dg, tgts, dist)
-        return (fm.reshape(n_workers, -1, dg.n),
-                dist.reshape(n_workers, -1, dg.n))
+        fm_wrn = fm.reshape(n_workers, -1, dg.n)
+        if with_dists:
+            return fm_wrn, dist.reshape(n_workers, -1, dg.n)
+        return fm_wrn
 
     return _build
 
 
 def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
                      mesh: Mesh, chunk: int = 0,
-                     max_iters: int = 0, with_dists: bool = False):
+                     max_iters: int = 0, with_dists: bool = False,
+                     sg=None):
     """Build the full sharded CPD: int8 [W, R, N], axis 0 on ``worker``.
 
     ``chunk`` bounds per-device live distance rows (0 = whole shard at
@@ -96,13 +113,23 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
     int32 [W, R, N] (4x the fm memory): free-flow queries then need no
     walk at all — one gather answers d(s→t) (SURVEY.md §5: "distance-only
     answers need no extraction").
+
+    ``sg``: optional ``ops.shift_relax.ShiftGraph`` — switches the
+    relaxation to the gather-free shift path (3.4x faster on the bench
+    city; identical results).
     """
     w, r = targets_wr.shape
     if mesh.shape[WORKER_AXIS] != w:
         raise ValueError(
             f"targets rows ({w}) != mesh worker axis "
             f"({mesh.shape[WORKER_AXIS]})")
-    build = _build_fn(mesh, w, max_iters, with_dists)
+    if sg is not None:
+        fn = _build_fn(mesh, w, max_iters, with_dists,
+                       shift_sig=(sg.shifts, sg.n, sg.k_left))
+        build = lambda dg_, t_: fn(  # noqa: E731
+            dg_, sg.w_shift, sg.nbr_left, sg.w_left, t_)
+    else:
+        build = _build_fn(mesh, w, max_iters, with_dists)
     if chunk <= 0 or chunk >= r:
         chunks = [targets_wr]
     else:

@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference README.md:93)")
     p.add_argument("--chunk", type=int, default=0,
                    help="build-step rows (0 = whole shard at once)")
+    p.add_argument("--method", default="auto",
+                   choices=["auto", "shift", "ell"],
+                   help="relaxation kernel: gather-free shift path, "
+                        "padded-ELL gather, or auto by shift coverage")
     p.add_argument("--no-resume", action="store_true",
                    help="rebuild blocks even if their files exist")
     p.add_argument("-v", "--verbose", action="count", default=0)
@@ -59,7 +63,8 @@ def main(argv=None) -> int:
                                 graph.n)
     written = build_worker_shard(graph, dc, args.workerid, outdir,
                                  chunk=args.chunk,
-                                 resume=not args.no_resume)
+                                 resume=not args.no_resume,
+                                 method=args.method)
     log.info("worker %d: wrote %d block(s) to %s",
              args.workerid, len(written), outdir)
     print(f"worker {args.workerid}: {len(written)} block(s) -> {outdir}")

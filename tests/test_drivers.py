@@ -323,3 +323,14 @@ def test_server_answers_malformed_request(host_conf, built_index, tmp_path):
     finally:
         stop_server(fifo)
         th.join(timeout=10)
+
+
+def test_make_cpds_test_mode_bootstraps_dataset(tmp_path, monkeypatch):
+    """-t must work in a fresh directory: it generates the canned synth
+    dataset itself (regression: it used to assume process_query -t had
+    already run)."""
+    from distributed_oracle_search_tpu.cli.make_cpds import main as cpds_main
+    monkeypatch.chdir(tmp_path)
+    assert cpds_main(["-t"]) == 0
+    assert os.path.exists("data/synth-city.xy")
+    assert os.path.exists("data/index/index.json")

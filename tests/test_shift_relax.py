@@ -1,0 +1,113 @@
+"""Gather-free shift relaxation: equivalence with the ELL gather path.
+
+The shift path must be a pure optimization — bit-identical distances and
+first moves on any graph, with automatic fallback when the node-id layout
+gives poor shift coverage.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import Graph, synth_city_graph
+from distributed_oracle_search_tpu.data.graph import INF
+from distributed_oracle_search_tpu.models.cpd import pick_shift_graph
+from distributed_oracle_search_tpu.models.reference import dist_to_target
+from distributed_oracle_search_tpu.ops import DeviceGraph
+from distributed_oracle_search_tpu.ops.bellman_ford import dist_to_targets
+from distributed_oracle_search_tpu.ops.shift_relax import (
+    ShiftGraph, build_fm_columns_shift, dist_to_targets_shift,
+)
+
+
+def _shuffled(graph: Graph, seed=5) -> Graph:
+    """Same graph, node ids randomly permuted — destroys shift locality."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(graph.n)
+    return Graph(graph.xs[np.argsort(perm)], graph.ys[np.argsort(perm)],
+                 perm[graph.src], perm[graph.dst], graph.w)
+
+
+def test_shift_split_partitions_edges(toy_graph):
+    shifts, w_shift, nbr_left, w_left = toy_graph.shift_split()
+    on_shift = int((w_shift < int(INF)).sum())
+    left = int((w_left < int(INF)).sum()) if w_left.size else 0
+    # parallel (same src, same delta) edges collapse to their min in
+    # w_shift, so covered slots <= covered edges; total never exceeds m
+    assert on_shift + left <= toy_graph.m
+    assert on_shift > 0
+
+
+def test_shift_split_takes_min_of_parallel_edges():
+    # two parallel edges 0->1 with different weights: shift slot = min
+    g = Graph([0, 1], [0, 0], [0, 0], [1, 1], [7, 3])
+    shifts, w_shift, _, _ = g.shift_split()
+    si = shifts.index(1)
+    assert w_shift[si][0] == 3
+
+
+@pytest.mark.parametrize("batch", [1, 7, 32])
+def test_shift_dist_matches_ell(toy_graph, batch):
+    dg = DeviceGraph.from_graph(toy_graph)
+    sg = ShiftGraph.from_graph(toy_graph)
+    tg = np.arange(batch, dtype=np.int32)
+    a = np.asarray(dist_to_targets(dg, tg))
+    b = np.asarray(dist_to_targets_shift(sg, tg))
+    assert (a == b).all()
+
+
+def test_shift_dist_matches_on_shuffled_ids(toy_graph):
+    """Poor locality -> big leftover ELL; results must still be exact."""
+    g = _shuffled(toy_graph)
+    dg = DeviceGraph.from_graph(g)
+    sg = ShiftGraph.from_graph(g, max_shifts=4)
+    assert sg.k_left > 0  # the fallback path is actually exercised
+    tg = np.arange(10, dtype=np.int32)
+    a = np.asarray(dist_to_targets(dg, tg))
+    b = np.asarray(dist_to_targets_shift(sg, tg))
+    assert (a == b).all()
+    # and both agree with the CPU oracle
+    for t in range(5):
+        assert (a[t] == dist_to_target(g, t)).all()
+
+
+def test_shift_fm_matches_ell(toy_graph):
+    from distributed_oracle_search_tpu.ops import build_fm_columns
+
+    dg = DeviceGraph.from_graph(toy_graph)
+    sg = ShiftGraph.from_graph(toy_graph)
+    tg = np.arange(12, dtype=np.int32)
+    a = np.asarray(build_fm_columns(dg, tg))
+    b = np.asarray(build_fm_columns_shift(dg, sg, tg))
+    assert (a == b).all()
+
+
+def test_shift_handles_padding_targets(toy_graph):
+    sg = ShiftGraph.from_graph(toy_graph)
+    tg = np.array([3, -1, 5], np.int32)
+    d = np.asarray(dist_to_targets_shift(sg, tg))
+    assert (d[1] >= int(INF)).all()          # padding row all-INF
+    assert d[0][3] == 0 and d[2][5] == 0
+
+
+def test_auto_method_selection(toy_graph):
+    assert pick_shift_graph(toy_graph, "auto") is not None  # grid ids
+    assert pick_shift_graph(toy_graph, "ell") is None
+    assert pick_shift_graph(toy_graph, "shift") is not None
+    with pytest.raises(ValueError, match="unknown build method"):
+        pick_shift_graph(toy_graph, "bogus")
+
+
+def test_oracle_build_methods_agree(toy_graph, toy_queries):
+    from distributed_oracle_search_tpu.models.cpd import CPDOracle
+    from distributed_oracle_search_tpu.parallel import DistributionController
+    from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+
+    dc = DistributionController("tpu", None, 4, toy_graph.n)
+    o1 = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=4))
+    o1.build(method="ell")
+    o2 = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=4))
+    o2.build(method="shift")
+    assert (np.asarray(o1.fm) == np.asarray(o2.fm)).all()
+    c1, _, f1 = o1.query(toy_queries)
+    c2, _, f2 = o2.query(toy_queries)
+    assert (c1 == c2).all() and (f1 == f2).all() and f1.all()
