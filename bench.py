@@ -106,6 +106,27 @@ def main() -> None:
     log(f"dist gather:   {n_queries} in {t_dist} -> "
         f"{n_queries / t_dist.interval:,.0f} q/s")
 
+    # pointer-doubling amortization path: whole-shard cost tables for the
+    # DIFFED weights, then gather-speed answers. Costs O(R*N*log L)
+    # gathers up front — the >1M-query trade (BASELINE.md configs[4]) —
+    # so it only runs when explicitly requested.
+    table_stats = {}
+    if os.environ.get("BENCH_TABLE", "0") == "1":
+        with Timer() as t_prep:
+            tables = oracle.prepare_weights(w_diff)
+            jax.block_until_ready(tables[0])
+        with Timer() as t_tab:
+            cost_t, plen_t, fin_t = oracle.query_table(tables, queries)
+        assert (cost_t == cost_d).all(), \
+            "table path must match the diff walk"
+        assert (plen_t == plen_d).all() and (fin_t == fin_d).all()
+        log(f"diff tables:   prepare {t_prep}; {n_queries} in {t_tab} -> "
+            f"{n_queries / t_tab.interval:,.0f} q/s")
+        table_stats = {
+            "table_prepare_seconds": round(t_prep.interval, 3),
+            "table_queries_per_sec": round(n_queries / t_tab.interval, 1),
+        }
+
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     print(json.dumps({
         "metric": "scenario_queries_per_sec",
@@ -119,6 +140,7 @@ def main() -> None:
             "scenario_seconds": round(t_scen.interval, 4),
             "diff_queries_per_sec": round(n_queries / t_diff.interval, 1),
             "dist_queries_per_sec": round(n_queries / t_dist.interval, 1),
+            **table_stats,
             "cpd_build_seconds": round(t_build.interval, 2),
             "cpd_rows_per_sec": round(rows_per_s, 1),
             "devices": len(devices),

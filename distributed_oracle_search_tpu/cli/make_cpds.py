@@ -71,6 +71,9 @@ def call_worker(wid: int, conf: ClusterConfig, chunk: int = 0,
 
 def run_tpu(conf: ClusterConfig, args) -> None:
     """In-process sharded build over the mesh."""
+    from ..parallel.multihost import initialize_from_conf
+    initialize_from_conf(conf)
+
     from ..data.graph import Graph
     from ..models.cpd import CPDOracle
     from ..parallel.mesh import make_mesh

@@ -183,6 +183,9 @@ def run(conf: ClusterConfig, args):
 
     use_tpu = args.backend == "tpu" or (args.backend == "auto"
                                         and partmethod == "tpu")
+    if use_tpu:
+        from ..parallel.multihost import initialize_from_conf
+        initialize_from_conf(conf)
     with Timer() as t_process:
         if use_tpu:
             stats = run_tpu(conf, args, queries, dc, diffs)

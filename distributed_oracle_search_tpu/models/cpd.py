@@ -39,7 +39,8 @@ from ..parallel.mesh import (
 )
 from ..parallel.partition import DistributionController
 from ..parallel.sharded import (
-    pad_targets, build_fm_sharded, query_dist_sharded, query_sharded,
+    build_tables_sharded, pad_targets, build_fm_sharded,
+    query_dist_sharded, query_sharded, query_tables_sharded,
 )
 
 INDEX_VERSION = 1
@@ -330,6 +331,70 @@ class CPDOracle:
         out_c[active] = cost[sd[active], sw[active], sq[active]]
         out_p[active] = plen[sd[active], sw[active], sq[active]]
         out_f[active] = fin[sd[active], sw[active], sq[active]]
+        return out_c, out_p, out_f
+
+    # ------------------------------------------------- prepared tables
+    def prepare_weights(self, w_query: np.ndarray | None = None,
+                        max_len: int = 0, chunk: int = 2048):
+        """Pointer-doubling: precompute cost/plen/finished for EVERY
+        (source, owned-target) pair under ``w_query`` in O(log L) sweeps
+        (``ops.pointer_doubling``). After this, :meth:`query_table`
+        answers any query on these weights with one gather — the
+        amortization path for huge campaigns (BASELINE.md's 10M-query
+        config), including congestion-diffed rounds where
+        :meth:`query_dist` does not apply.
+
+        ``chunk`` bounds the per-device rows doubled at once (several
+        [rows, N] int32 live arrays per sweep; oversized batches fault).
+
+        Returns an opaque tables handle to pass to :meth:`query_table`.
+        """
+        if self.fm is None:
+            raise RuntimeError("build() or load() before prepare_weights()")
+        w_pad = (self.dg.w_pad if w_query is None
+                 else jnp.asarray(self.graph.padded_weights(w_query),
+                                  jnp.int32))
+        r = self.targets_wr.shape[1]
+        if chunk <= 0 or chunk >= r:
+            return build_tables_sharded(self.dg, self.fm, self.targets_wr,
+                                        w_pad, self.mesh, max_len=max_len)
+        # equal row-chunks (pad targets) so every chunk reuses one program
+        pad = (-r) % chunk
+        tw = self.targets_wr
+        fm = self.fm
+        if pad:
+            tw = np.concatenate(
+                [tw, np.full((tw.shape[0], pad), -1, tw.dtype)], axis=1)
+            fm = jnp.concatenate(
+                [fm, jnp.full((fm.shape[0], pad, fm.shape[2]), -1,
+                              fm.dtype)], axis=1)
+        parts = [build_tables_sharded(
+                     self.dg, fm[:, i:i + chunk], tw[:, i:i + chunk],
+                     w_pad, self.mesh, max_len=max_len)
+                 for i in range(0, tw.shape[1], chunk)]
+        cat = lambda xs: jnp.concatenate(xs, axis=1)[:, :r]  # noqa: E731
+        c, p, f = zip(*parts)
+        return cat(c), cat(p), cat(f)
+
+    def query_table(self, tables, queries: np.ndarray,
+                    active_worker: int = -1):
+        """Answer queries from :meth:`prepare_weights` tables.
+
+        Returns ``(cost, plen, finished)`` — identical to :meth:`query`
+        on the same weights (tests pin this), at gather speed.
+        """
+        r_arr, s_arr, t_arr, valid, scatter = self.route(
+            queries, active_worker)
+        c, p, f = map(np.asarray, query_tables_sharded(
+            tables, r_arr, s_arr, valid, self.mesh))
+        nq = len(queries)
+        active, sd, sw, sq = scatter
+        out_c = np.zeros(nq, np.int64)
+        out_p = np.zeros(nq, np.int64)
+        out_f = np.zeros(nq, bool)
+        out_c[active] = c[sd[active], sw[active], sq[active]]
+        out_p[active] = p[sd[active], sw[active], sq[active]]
+        out_f[active] = f[sd[active], sw[active], sq[active]]
         return out_c, out_p, out_f
 
     def query_dist(self, queries: np.ndarray, active_worker: int = -1):

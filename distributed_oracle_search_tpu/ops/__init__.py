@@ -1,8 +1,12 @@
 from .device_graph import DeviceGraph
 from .bellman_ford import dist_to_targets, first_move_from_dist, build_fm_columns
-from .table_search import table_search_batch
+from .table_search import extract_paths, table_search_batch
+from .pointer_doubling import doubled_tables, lookup_tables
+from .shift_relax import ShiftGraph, dist_to_targets_shift
 
 __all__ = [
     "DeviceGraph", "dist_to_targets", "first_move_from_dist",
-    "build_fm_columns", "table_search_batch",
+    "build_fm_columns", "table_search_batch", "extract_paths",
+    "doubled_tables", "lookup_tables", "ShiftGraph",
+    "dist_to_targets_shift",
 ]

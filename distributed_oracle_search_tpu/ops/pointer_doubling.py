@@ -1,0 +1,109 @@
+"""Pointer-doubling: whole-shard path costs in O(log L) sweeps.
+
+The framework's "long-context" machinery (SURVEY.md §5): a table-search
+walk is a sequential chain of up to L = max-path-length dependent gathers —
+the structural analog of a long sequence. Instead of walking each query,
+**double the successor function**: with
+
+    S_0[r, x] = next node on the CPD path from x toward target r
+    C_0[r, x] = query-time cost of that one move
+
+repeated squaring
+
+    S_{k+1}[r, x] = S_k[r, S_k[r, x]]
+    C_{k+1}[r, x] = C_k[r, x] + C_k[r, S_k[r, x]]
+
+converges in ceil(log2 L) sweeps to the TOTAL cost from every node to
+every owned target — after which any (s, t) query is ONE gather, on diffed
+weights too (the walk's only advantage was laziness).
+
+Cost model (bench graph, v5e): one sweep gathers 2·R·N elements; log2(L)≈8
+sweeps ≈ a few seconds — worth it when a diff round answers more than
+roughly ``R·N·log2(L) / L`` queries (~1M on the bench shapes; the DIMACS
+10M-query campaign in BASELINE.md §configs[4] is the target workload).
+Self-loops make the recursion total: the target itself and stuck
+(unreachable) nodes point at themselves with step cost 0, so their
+accumulated cost is exactly the walk's cost-until-stuck.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .device_graph import DeviceGraph
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def doubled_tables(dg: DeviceGraph, fm: jnp.ndarray, targets: jnp.ndarray,
+                   w_query_pad: jnp.ndarray, max_len: int = 0):
+    """All-source cost/plen/finished tables for one fm shard.
+
+    Parameters
+    ----------
+    fm          : int8 [R, N] first-move rows (free-flow moves)
+    targets     : int32 [R] global node id of each row's target (-1 pad)
+    w_query_pad : int32 [M+1] query-time weights (diff applied)
+    max_len     : path-length bound (0 = N, the simple-path bound)
+
+    Returns
+    -------
+    cost [R, N] int32, plen [R, N] int32, finished [R, N] bool
+    (rows with targets[r] < 0 are all-unfinished padding)
+    """
+    r, n = fm.shape
+    limit = n if max_len == 0 else max_len
+    rows = jnp.arange(r, dtype=jnp.int32)[:, None]
+    x = jnp.arange(n, dtype=jnp.int32)[None, :]
+
+    slot = fm.astype(jnp.int32)
+    can = slot >= 0
+    slot_safe = jnp.maximum(slot, 0)
+    eid = dg.out_eid[x.repeat(r, 0), slot_safe]
+    nxt = dg.out_nbr[x.repeat(r, 0), slot_safe]
+    succ = jnp.where(can, nxt, x)                  # self-loop when stuck
+    cost = jnp.where(can, w_query_pad[eid], 0)
+    plen = jnp.where(can, 1, 0).astype(jnp.int32)
+
+    n_sweeps = max(int(limit - 1).bit_length(), 1)
+
+    def cond(state):
+        i, _, _, _, changed = state
+        return changed & (i < n_sweeps)
+
+    def body(state):
+        i, succ, cost, plen, _ = state
+        cost = cost + jnp.take_along_axis(cost, succ, axis=1)
+        plen = plen + jnp.take_along_axis(plen, succ, axis=1)
+        new_succ = jnp.take_along_axis(succ, succ, axis=1)
+        # converged once every chain reached its fixed point: the sweep
+        # count then adapts to log2(actual max path length), not log2(N)
+        return i + 1, new_succ, cost, plen, jnp.any(new_succ != succ)
+
+    _, succ, cost, plen, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), succ, cost, plen, True))
+
+    valid = targets >= 0
+    t_safe = jnp.where(valid, targets, 0).astype(jnp.int32)
+    finished = (succ == t_safe[:, None]) & valid[:, None]
+    del rows
+    return cost, plen, finished
+
+
+@jax.jit
+def lookup_tables(cost: jnp.ndarray, plen: jnp.ndarray,
+                  finished: jnp.ndarray, t_rows: jnp.ndarray,
+                  s: jnp.ndarray, valid: jnp.ndarray | None = None):
+    """Answer queries from prepared tables: one 2-D gather each."""
+    rows = t_rows.astype(jnp.int32)
+    s32 = s.astype(jnp.int32)
+    c = cost[rows, s32]
+    p = plen[rows, s32]
+    f = finished[rows, s32]
+    if valid is not None:
+        c = jnp.where(valid, c, 0)
+        p = jnp.where(valid, p, 0)
+        f = f & valid
+    return c, p, f

@@ -116,3 +116,35 @@ def table_search_batch(dg: DeviceGraph, fm: jnp.ndarray,
     cost = jnp.where(valid, cost, 0)
     plen = jnp.where(valid, plen, 0)
     return cost, plen, finished
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def extract_paths(dg: DeviceGraph, fm: jnp.ndarray, t_rows: jnp.ndarray,
+                  s: jnp.ndarray, t: jnp.ndarray, k: int):
+    """Materialize the first ``k`` moves of each query's CPD path.
+
+    The reference's prefix extraction (``--k-moves``, reference
+    ``args.py:31-36``: "number of moves to extract"): beyond a cost, a
+    navigation client wants the next few road segments. One ``lax.scan``
+    over ``k`` steps collects the node sequence for the whole batch at
+    once.
+
+    Returns ``(nodes, plen)``: int32 ``[Q, k+1]`` node ids — row q starts
+    at ``s[q]``; after the path ends (target reached or stuck) the last
+    node repeats — and the number of real moves taken (≤ k).
+    """
+    rows32 = t_rows.astype(jnp.int32)
+    t32 = t.astype(jnp.int32)
+    x0 = s.astype(jnp.int32)
+
+    def step(x, _):
+        slot = fm[rows32, x].astype(jnp.int32)
+        can = (slot >= 0) & (x != t32)
+        nxt = dg.out_nbr[x, jnp.maximum(slot, 0)]
+        x = jnp.where(can, nxt, x)
+        return x, (x, can)
+
+    _, (xs, cans) = jax.lax.scan(step, x0, None, length=k)
+    nodes = jnp.concatenate([x0[None, :], xs], axis=0).T  # [Q, k+1]
+    plen = cans.sum(axis=0).astype(jnp.int32)
+    return nodes, plen

@@ -152,6 +152,77 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
     return fm[:, :r]
 
 
+# ----------------------------------------------------------- cost tables
+
+@functools.lru_cache(maxsize=None)
+def _tables_fn(mesh: Mesh, max_len: int):
+    from ..ops.pointer_doubling import doubled_tables
+
+    def _local(dg, fm_local, tgt_local, w_pad):
+        # local blocks: fm [1, R, N], tgt [1, R]
+        return doubled_tables(dg, fm_local[0], tgt_local[0], w_pad,
+                              max_len=max_len)
+
+    sm = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, None), P(WORKER_AXIS, None),
+                  P()),
+        out_specs=(P(WORKER_AXIS, None), P(WORKER_AXIS, None),
+                   P(WORKER_AXIS, None)),
+    )
+
+    def _wrap(dg, fm_wrn, tgt_wr, w_pad):
+        c, p, f = sm(dg, fm_wrn, tgt_wr, w_pad)
+        # shard_map emits [W*R, N] (axis-0 concat of local [R, N]); restore
+        # the worker axis
+        w = fm_wrn.shape[0]
+        return (c.reshape(w, -1, dg.n), p.reshape(w, -1, dg.n),
+                f.reshape(w, -1, dg.n))
+
+    return jax.jit(_wrap)
+
+
+def build_tables_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
+                         targets_wr: np.ndarray, w_query_pad, mesh: Mesh,
+                         max_len: int = 0):
+    """Pointer-doubling cost/plen/finished tables, one shard per worker
+    (each worker doubles only its own rows — zero cross-shard traffic)."""
+    tgt = jax.device_put(
+        jnp.asarray(targets_wr, jnp.int32),
+        NamedSharding(mesh, P(WORKER_AXIS, None)))
+    fn = _tables_fn(mesh, max_len)
+    return fn(dg, fm_wrn, tgt, jnp.asarray(w_query_pad))
+
+
+@functools.lru_cache(maxsize=None)
+def _query_table_fn(mesh: Mesh):
+    from ..ops.pointer_doubling import lookup_tables
+
+    q3 = P(DATA_AXIS, WORKER_AXIS, None)
+
+    def _local(cost, plen, fin, rows, s, valid):
+        shape = s.shape
+        c, p, f = lookup_tables(cost[0], plen[0], fin[0],
+                                rows.reshape(-1), s.reshape(-1),
+                                valid.reshape(-1))
+        return c.reshape(shape), p.reshape(shape), f.reshape(shape)
+
+    t3 = P(WORKER_AXIS, None, None)
+    sm = jax.shard_map(_local, mesh=mesh,
+                       in_specs=(t3, t3, t3, q3, q3, q3),
+                       out_specs=(q3, q3, q3))
+    return jax.jit(sm)
+
+
+def query_tables_sharded(tables, t_rows, s, valid, mesh: Mesh):
+    """Answer routed [D, W, Q] queries from prepared cost tables."""
+    cost, plen, fin = tables
+    qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
+    rows_d, s_d, v_d = (jax.device_put(jnp.asarray(a), qs)
+                        for a in (t_rows, s, valid))
+    return _query_table_fn(mesh)(cost, plen, fin, rows_d, s_d, v_d)
+
+
 # --------------------------------------------------------------------- query
 
 @functools.lru_cache(maxsize=None)

@@ -49,6 +49,10 @@ class ClusterConfig:
     # TPU-mode extensions (ignored by host mode)
     mesh_shape: Sequence[int] | None = None
     mesh_axes: Sequence[str] | None = None
+    # multi-host: {"coordinator": "host:port", "num_processes": N,
+    # "process_id": i (or $DOS_PROCESS_ID / TPU auto-detect)} — see
+    # parallel/multihost.py
+    multihost: dict | None = None
 
     @property
     def maxworker(self) -> int:

@@ -1,0 +1,57 @@
+"""Subprocess body for the 2-process multi-host test (not a pytest file).
+
+Each process owns 4 virtual CPU devices; together they form one 8-device
+worker mesh spanning both processes — the single-machine stand-in for a
+multi-host TPU pod (DCN between hosts). Builds a sharded CPD on the global
+mesh, allgathers it, and checks it against the CPU oracle.
+
+Usage: multihost_worker.py <process_id> <num_processes> <coordinator>
+"""
+
+import os
+import sys
+
+pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_oracle_search_tpu.parallel.multihost import (  # noqa: E402
+    gather_to_host, initialize,
+)
+
+# config-level CPU override: the host may pin another platform via
+# sitecustomize, which trumps JAX_PLATFORMS env vars
+initialize(coordinator=coord, num_processes=nproc, process_id=pid,
+           cpu_devices_per_process=4)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.devices()) == 4 * nproc, jax.devices()
+
+from distributed_oracle_search_tpu.data import synth_city_graph  # noqa: E402
+from distributed_oracle_search_tpu.models.cpd import CPDOracle  # noqa: E402
+from distributed_oracle_search_tpu.models.reference import (  # noqa: E402
+    first_move_matrix,
+)
+from distributed_oracle_search_tpu.parallel import (  # noqa: E402
+    DistributionController,
+)
+from distributed_oracle_search_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+n_workers = 4 * nproc
+g = synth_city_graph(8, 6, seed=7)
+dc = DistributionController("tpu", None, n_workers, g.n)
+mesh = make_mesh(n_workers=n_workers)  # spans BOTH processes' devices
+oracle = CPDOracle(g, dc, mesh=mesh)
+oracle.build()
+
+fm_global = gather_to_host(oracle.fm)  # [W, R, N] on every process
+golden = first_move_matrix(g, np.arange(g.n))
+for wid in range(n_workers):
+    owned = dc.owned(wid)
+    got = fm_global[wid, :len(owned)]
+    assert (got == golden[owned]).all(), f"worker {wid} rows differ"
+
+print(f"MULTIHOST_OK process={pid} devices={len(jax.devices())}")

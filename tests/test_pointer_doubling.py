@@ -1,0 +1,112 @@
+"""Pointer-doubling cost tables + path extraction.
+
+The O(log L) "long-context" machinery: doubled tables must agree exactly
+with the sequential walk on free-flow AND diffed weights, and extracted
+path prefixes must match the CPU oracle's walk node-for-node.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import synth_diff
+from distributed_oracle_search_tpu.models import (
+    first_move_matrix, table_search_walk,
+)
+from distributed_oracle_search_tpu.models.cpd import CPDOracle
+from distributed_oracle_search_tpu.ops import (
+    DeviceGraph, doubled_tables, extract_paths, lookup_tables,
+)
+from distributed_oracle_search_tpu.parallel import DistributionController
+from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def setup(toy_graph):
+    g = toy_graph
+    fm = first_move_matrix(g, np.arange(g.n))
+    dg = DeviceGraph.from_graph(g)
+    return g, fm, dg
+
+
+def test_doubled_tables_match_walk_free_flow(setup):
+    g, fm, dg = setup
+    targets = jnp.arange(g.n, dtype=jnp.int32)
+    c, p, f = doubled_tables(dg, jnp.asarray(fm), targets,
+                             jnp.asarray(g.padded_weights(), jnp.int32))
+    c, p, f = map(np.asarray, (c, p, f))
+    fm_of = lambda x, t: fm[t, x]  # noqa: E731
+    for t in range(0, g.n, 7):
+        for s in range(0, g.n, 5):
+            wc, wp, wf, _ = table_search_walk(g, fm_of, s, t)
+            assert c[t, s] == wc and p[t, s] == wp and f[t, s] == wf
+
+
+def test_doubled_tables_match_walk_diffed(setup):
+    g, fm, dg = setup
+    w = g.weights_with_diff(synth_diff(g, frac=0.3, seed=9))
+    targets = jnp.arange(g.n, dtype=jnp.int32)
+    c, p, f = doubled_tables(dg, jnp.asarray(fm), targets,
+                             jnp.asarray(g.padded_weights(w), jnp.int32))
+    c = np.asarray(c)
+    fm_of = lambda x, t: fm[t, x]  # noqa: E731
+    for t in range(0, g.n, 6):
+        for s in range(0, g.n, 4):
+            wc, _, _, _ = table_search_walk(g, fm_of, s, t, w_query=w)
+            assert c[t, s] == wc
+
+
+def test_doubled_tables_padding_rows(setup):
+    g, fm, dg = setup
+    targets = jnp.asarray([0, -1, 2], jnp.int32)
+    c, p, f = doubled_tables(dg, jnp.asarray(fm[[0, 0, 2]]), targets,
+                             jnp.asarray(g.padded_weights(), jnp.int32))
+    assert not np.asarray(f)[1].any()  # padding row unfinished
+
+
+def test_lookup_tables_roundtrip(setup):
+    g, fm, dg = setup
+    targets = jnp.arange(g.n, dtype=jnp.int32)
+    tables = doubled_tables(dg, jnp.asarray(fm), targets,
+                            jnp.asarray(g.padded_weights(), jnp.int32))
+    rows = jnp.asarray([3, 8], jnp.int32)
+    s = jnp.asarray([1, 40], jnp.int32)
+    c, p, f = lookup_tables(*tables, rows, s)
+    assert np.asarray(f).all()
+    assert np.asarray(c)[0] == np.asarray(tables[0])[3, 1]
+
+
+def test_oracle_query_table_matches_query(toy_graph, toy_queries):
+    """End-to-end sharded: prepared tables == walked answers, free-flow
+    and diffed."""
+    dc = DistributionController("tpu", None, 4, toy_graph.n)
+    oracle = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=4)).build()
+    w = toy_graph.weights_with_diff(synth_diff(toy_graph, frac=0.2,
+                                               seed=17))
+    for w_query in (None, w):
+        tables = oracle.prepare_weights(w_query)
+        c1, p1, f1 = oracle.query(toy_queries, w_query=w_query)
+        c2, p2, f2 = oracle.query_table(tables, toy_queries)
+        assert (c1 == c2).all() and (p1 == p2).all() and (f1 == f2).all()
+        assert f2.all()
+
+
+def test_extract_paths_match_cpu_walk(setup):
+    g, fm, dg = setup
+    rng = np.random.default_rng(23)
+    s = rng.integers(0, g.n, 16)
+    t = rng.integers(0, g.n, 16)
+    k = 10
+    nodes, plen = extract_paths(
+        dg, jnp.asarray(fm), jnp.asarray(t, jnp.int32),
+        jnp.asarray(s, jnp.int32), jnp.asarray(t, jnp.int32), k)
+    nodes, plen = np.asarray(nodes), np.asarray(plen)
+    fm_of = lambda x, tt: fm[tt, x]  # noqa: E731
+    for q in range(16):
+        _, wp, _, path = table_search_walk(g, fm_of, int(s[q]), int(t[q]),
+                                           k_moves=k)
+        assert plen[q] == wp
+        assert list(nodes[q][:wp + 1]) == path[:wp + 1]
+        # after the walk ends, the last node repeats
+        assert (nodes[q][wp:] == nodes[q][wp]).all()
