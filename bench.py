@@ -77,12 +77,19 @@ def main() -> None:
     dsrc, ddst, dw = synth_diff(g, frac=0.1, seed=2)
     w_diff = g.weights_with_diff((dsrc, ddst, dw))
 
+    bench_table = os.environ.get("BENCH_TABLE", "1") != "0"
+
     # warm-up at the full scenario shape: compiles each query program once,
     # like the reference's resident fifo_auto loading before the campaign
     with Timer() as t_compile:
         oracle.query(queries)
         oracle.query(queries, w_query=w_diff)
         oracle.query_dist(queries)
+        if bench_table:
+            warm = oracle.prepare_weights(w_diff)
+            oracle.query_table(warm, queries)
+            jax.block_until_ready(warm[0])
+            del warm
     log(f"query warm-up (compile): {t_compile}")
 
     with Timer() as t_scen:
@@ -108,10 +115,10 @@ def main() -> None:
 
     # pointer-doubling amortization path: whole-shard cost tables for the
     # DIFFED weights, then gather-speed answers. Costs O(R*N*log L)
-    # gathers up front — the >1M-query trade (BASELINE.md configs[4]) —
-    # so it only runs when explicitly requested.
+    # gathers up front — the >1M-query trade (BASELINE.md configs[4]).
+    # BENCH_TABLE=0 skips it for quick runs.
     table_stats = {}
-    if os.environ.get("BENCH_TABLE", "0") == "1":
+    if bench_table:
         with Timer() as t_prep:
             tables = oracle.prepare_weights(w_diff)
             jax.block_until_ready(tables[0])

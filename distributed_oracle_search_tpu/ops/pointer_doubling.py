@@ -82,8 +82,13 @@ def doubled_tables(dg: DeviceGraph, fm: jnp.ndarray, targets: jnp.ndarray,
         # count then adapts to log2(actual max path length), not log2(N)
         return i + 1, new_succ, cost, plen, jnp.any(new_succ != succ)
 
+    # Seed `changed` from the data (True iff some chain is not yet at its
+    # fixed point) rather than the literal True: under shard_map the body's
+    # jnp.any(...) output is varying over the worker axis, so the initial
+    # carry must be varying too or tracing rejects the loop.
+    changed0 = jnp.any(succ != x)
     _, succ, cost, plen, _ = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), succ, cost, plen, True))
+        cond, body, (jnp.int32(0), succ, cost, plen, changed0))
 
     valid = targets >= 0
     t_safe = jnp.where(valid, targets, 0).astype(jnp.int32)
