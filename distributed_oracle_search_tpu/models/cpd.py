@@ -53,12 +53,66 @@ def shard_block_name(wid: int, bid: int) -> str:
 #: shift coverage below which auto falls back to the ELL gather relaxation
 SHIFT_COVERAGE_MIN = 0.9
 
+#: lattice-edge share below which auto will not pick the fast-sweeping
+#: build (shift planes keep sweep correct on any graph, but only lattice
+#: edges benefit from the quadrant scans)
+SWEEP_COVERAGE_MIN = 0.75
+
+#: below this node count the per-hop shift relaxation beats the sweep's
+#: scan overhead (measured crossover ~25k nodes on v5e)
+SWEEP_MIN_NODES = 32_768
+
+
+def pick_build_kernel(graph: Graph, method: str = "auto"):
+    """Resolve the build-method knob to ``(kind, structure)``.
+
+    ``kind`` ∈ {"sweep", "shift", "ell"}; ``structure`` is the matching
+    host-side bundle (GridGraph / ShiftGraph / None). The coverage
+    decisions happen on host-side split arrays — graphs that fall back
+    never pay a device transfer.
+
+    ``auto`` picks the fast-sweeping build for large grid-structured
+    graphs (O(cycles) not O(hop-diameter) — the only build that scales to
+    the 100k+-node regime), the shift relaxation for smaller or
+    non-lattice-but-banded graphs, and the padded-ELL gather otherwise.
+    """
+    from ..ops.device_graph import JINF
+    from ..ops.grid_sweep import GridGraph
+    from ..ops.shift_relax import ShiftGraph, split_coverage
+
+    if method not in ("auto", "ell", "shift", "sweep"):
+        raise ValueError(f"unknown build method {method!r}")
+    if method == "ell":
+        return "ell", None
+    if method in ("auto", "sweep"):
+        split = graph.grid_split()
+        if split is not None:
+            if method == "sweep":
+                return "sweep", GridGraph(*split)
+            # lattice share from the HOST arrays (no device transfer for
+            # graphs the gate rejects): what the quadrant scans serve
+            _, _, wl, wr, wd, wu, _, w_shift, src_left, _, _ = split
+            on_grid = sum(int((np.asarray(a) < int(JINF)).sum())
+                          for a in (wl, wr, wd, wu))
+            total = (on_grid + int((np.asarray(w_shift) < int(JINF)).sum())
+                     + len(src_left))
+            if (total and on_grid / total >= SWEEP_COVERAGE_MIN
+                    and graph.n >= SWEEP_MIN_NODES):
+                return "sweep", GridGraph(*split)
+        elif method == "sweep":
+            raise ValueError("method='sweep' but no grid layout fits "
+                             "(Graph.grid_split returned None)")
+    shifts, w_shift, nbr_left, w_left = graph.shift_split()
+    if method == "auto" and split_coverage(w_shift,
+                                           w_left) < SHIFT_COVERAGE_MIN:
+        return "ell", None
+    return "shift", ShiftGraph(shifts, w_shift, nbr_left, w_left, graph.n)
+
 
 def pick_shift_graph(graph: Graph, method: str = "auto"):
-    """Resolve the build-method knob to an optional ShiftGraph.
-
-    The coverage decision happens on the host-side split arrays — graphs
-    that fall back to ELL never pay a device transfer.
+    """Back-compat shim: the optional ShiftGraph of the old 3-method knob
+    (sweep resolution lives in :func:`pick_build_kernel`; this never
+    resolves to sweep, so existing shift-path callers keep their kernel).
     """
     from ..ops.shift_relax import ShiftGraph, split_coverage
 
@@ -89,6 +143,7 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     (SURVEY.md §5 checkpoint/resume).
     """
     from ..ops import build_fm_columns
+    from ..ops.grid_sweep import build_fm_columns_sweep
     from ..ops.shift_relax import build_fm_columns_shift
 
     os.makedirs(outdir, exist_ok=True)
@@ -106,7 +161,7 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
                    os.path.join(outdir, shard_block_name(wid, bid))))]
     if not missing:
         return []
-    sg = pick_shift_graph(graph, method)
+    kind, structure = pick_build_kernel(graph, method)
     dg = DeviceGraph.from_graph(graph)
     written = []
     per_step = step // bs
@@ -117,8 +172,11 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         tgts = np.concatenate(blocks)
         pad = np.full(step, -1, np.int32)  # fixed shape -> one compile
         pad[:len(tgts)] = tgts
-        if sg is not None:
-            fm = np.asarray(build_fm_columns_shift(dg, sg, pad,
+        if kind == "sweep":
+            fm = np.asarray(build_fm_columns_sweep(dg, structure, pad,
+                                                   max_iters=max_iters))
+        elif kind == "shift":
+            fm = np.asarray(build_fm_columns_shift(dg, structure, pad,
                                                    max_iters=max_iters))
         else:
             fm = np.asarray(build_fm_columns(dg, jnp.asarray(pad),
@@ -164,6 +222,24 @@ def write_index_manifest(outdir: str, dc: DistributionController,
     return manifest
 
 
+def validate_manifest(manifest: dict, dc: DistributionController,
+                      outdir: str) -> None:
+    """Check a loaded ``index.json`` against the serving controller (the
+    reference keeps build and serve consistent by passing the same
+    partmethod/partkey quadruple everywhere; we verify it)."""
+    my_partkey = (list(dc.partkey)
+                  if isinstance(dc.partkey, (list, tuple)) else dc.partkey)
+    for key, mine in (("nodenum", dc.nodenum),
+                      ("maxworker", dc.maxworker),
+                      ("partmethod", dc.partmethod),
+                      ("partkey", my_partkey),
+                      ("block_size", dc.block_size)):
+        if manifest[key] != mine:
+            raise ValueError(
+                f"index {outdir} was built with {key}={manifest[key]}, "
+                f"controller has {mine}")
+
+
 class CPDOracle:
     def __init__(self, graph: Graph, controller: DistributionController,
                  mesh=None):
@@ -193,20 +269,20 @@ class CPDOracle:
         and are not persisted by :meth:`save` (they are a pure derivative
         of the graph; rebuild to get them back).
 
-        ``method``: ``"shift"`` forces the gather-free shift relaxation,
-        ``"ell"`` the padded-ELL gather relaxation, ``"auto"`` picks shift
-        when the graph's id layout puts ≥90% of edges on constant offsets
-        (:func:`pick_shift_graph`).
+        ``method``: ``"sweep"`` forces the fast-sweeping build, ``"shift"``
+        the gather-free shift relaxation, ``"ell"`` the padded-ELL gather
+        relaxation; ``"auto"`` resolves per :func:`pick_build_kernel`.
         """
-        sg = pick_shift_graph(self.graph, method)
+        kind, structure = pick_build_kernel(self.graph, method)
         if store_dists:
             self.fm, self.dists = build_fm_sharded(
                 self.dg, self.targets_wr, self.mesh, chunk=chunk,
-                max_iters=max_iters, with_dists=True, sg=sg)
+                max_iters=max_iters, with_dists=True,
+                kernel=(kind, structure))
         else:
             self.fm = build_fm_sharded(self.dg, self.targets_wr, self.mesh,
                                        chunk=chunk, max_iters=max_iters,
-                                       sg=sg)
+                                       kernel=(kind, structure))
         return self
 
     # ------------------------------------------------------- persistence
@@ -232,18 +308,7 @@ class CPDOracle:
         partmethod/partkey quadruple everywhere; we verify it)."""
         with open(os.path.join(outdir, "index.json")) as f:
             manifest = json.load(f)
-        my_partkey = (list(self.dc.partkey)
-                      if isinstance(self.dc.partkey, (list, tuple))
-                      else self.dc.partkey)
-        for key, mine in (("nodenum", self.dc.nodenum),
-                          ("maxworker", self.dc.maxworker),
-                          ("partmethod", self.dc.partmethod),
-                          ("partkey", my_partkey),
-                          ("block_size", self.dc.block_size)):
-            if manifest[key] != mine:
-                raise ValueError(
-                    f"index {outdir} was built with {key}={manifest[key]}, "
-                    f"controller has {mine}")
+        validate_manifest(manifest, self.dc, outdir)
         w = self.dc.maxworker
         r = self.targets_wr.shape[1]
         fm = np.full((w, r, self.graph.n), -1, np.int8)

@@ -1,0 +1,168 @@
+"""Streamed CPD serving: answer campaigns whose index exceeds HBM.
+
+The resident :class:`~..models.cpd.CPDOracle` holds the whole ``[W, R, N]``
+first-move tensor on the mesh — perfect until ``N^2 / W`` outgrows HBM
+(~16 GB on v5e: a 264k-node graph is a 70 GB single-shard table; the
+reference-scale regime of BASELINE.md configs[4-5]). The reference never
+faces this because its run-length-compressed CPD lives in host RAM and is
+pointer-chased per query (reference ``make_fifos.py:21``, SURVEY.md §C5);
+the TPU answer is **streaming**: keep the index on disk (the per-block
+``.npy`` checkpoint files ARE the serving format), and per batch upload
+only the fm rows the batch actually targets, in bounded row-chunks.
+
+A random scenario of Q queries touches ≤ Q distinct target rows — usually
+far fewer than R — and each uploaded ``[C, N]`` chunk answers every query
+aimed at those rows in one device walk. Row-chunks are ordered
+block-contiguously so the host-side gather reads each mmapped block file
+sequentially. Chunk size and padded query counts are compile-stable
+(powers of two), so a resident server reuses a handful of programs.
+
+This is deliberately a single-device serving mode: multi-chip scale-out
+uses the resident sharded oracle (sharding IS the memory plan); streaming
+is the fallback when one chip must serve an index bigger than its HBM,
+and the two share the same walk kernel and wire semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.graph import Graph
+from ..ops import DeviceGraph
+from ..ops.table_search import table_search_batch
+from ..parallel.partition import DistributionController
+from .cpd import shard_block_name, validate_manifest
+
+
+def _pow2(x: int) -> int:
+    return 1 << max(x - 1, 0).bit_length()
+
+
+class StreamedCPDOracle:
+    """Serve table-search queries from an on-disk CPD index, streaming
+    only the rows each batch needs.
+
+    Parameters
+    ----------
+    graph      : the (free-flow) road graph
+    controller : partition controller — must match the built index
+    outdir     : CPD index directory (``index.json`` + block files)
+    row_chunk  : fm rows resident per upload; the device-memory knob.
+                 Working set ≈ ``row_chunk * N`` bytes of int8 fm plus the
+                 walk state — e.g. 4096 rows x 264k nodes ≈ 1.1 GB.
+    """
+
+    def __init__(self, graph: Graph, controller: DistributionController,
+                 outdir: str, row_chunk: int = 4096):
+        self.graph = graph
+        self.dc = controller
+        self.outdir = outdir
+        self.row_chunk = int(row_chunk)
+        self.dg = DeviceGraph.from_graph(graph)
+        with open(os.path.join(outdir, "index.json")) as f:
+            manifest = json.load(f)
+        validate_manifest(manifest, controller, outdir)
+        self._blocks: dict[tuple[int, int], np.ndarray] = {}
+        #: telemetry of the most recent :meth:`query` call
+        self.last_stats: dict = {}
+
+    def _block(self, wid: int, bid: int) -> np.ndarray:
+        """Memory-mapped block file (cached handle, not cached data)."""
+        key = (wid, bid)
+        if key not in self._blocks:
+            path = os.path.join(self.outdir, shard_block_name(wid, bid))
+            self._blocks[key] = np.load(path, mmap_mode="r")
+        return self._blocks[key]
+
+    def _gather_rows(self, wids: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Host-side gather of fm rows (wid, owned-row) -> [C, N] int8."""
+        bs = self.dc.block_size
+        out = np.empty((len(rows), self.graph.n), np.int8)
+        bids = rows // bs
+        # group by (wid, bid) so each mmapped file is fancy-indexed once
+        order = np.lexsort((rows, bids, wids))
+        i = 0
+        while i < len(order):
+            j = i
+            wid, bid = wids[order[i]], bids[order[i]]
+            while (j < len(order) and wids[order[j]] == wid
+                   and bids[order[j]] == bid):
+                j += 1
+            sel = order[i:j]
+            out[sel] = self._block(int(wid), int(bid))[rows[sel] - bid * bs]
+            i = j
+        return out
+
+    def query(self, queries: np.ndarray, w_query: np.ndarray | None = None,
+              k_moves: int = -1, max_steps: int = 0):
+        """Answer (s, t) queries in input order: ``(cost, plen, finished)``.
+
+        Matches the resident oracle's :meth:`~.CPDOracle.query` semantics
+        exactly (tests pin this); only the memory plan differs.
+        """
+        queries = np.asarray(queries, np.int64)
+        nq = len(queries)
+        s_all, t_all = queries[:, 0], queries[:, 1]
+        w_pad = (self.dg.w_pad if w_query is None
+                 else jnp.asarray(self.graph.padded_weights(w_query),
+                                  jnp.int32))
+
+        # distinct targets, ordered block-contiguously for the host gather
+        uniq_t, inv = np.unique(t_all, return_inverse=True)
+        u_wid = self.dc.worker_of(uniq_t)
+        u_row = self.dc.owned_index_of(uniq_t)
+        u_order = np.lexsort((u_row, u_wid))
+        # position of each distinct target in the streaming order
+        pos_of_uniq = np.empty(len(uniq_t), np.int64)
+        pos_of_uniq[u_order] = np.arange(len(uniq_t))
+        q_pos = pos_of_uniq[inv]              # stream position per query
+
+        out_c = np.zeros(nq, np.int64)
+        out_p = np.zeros(nq, np.int64)
+        out_f = np.zeros(nq, bool)
+        c = self.row_chunk
+        n_chunks = -(-len(uniq_t) // c) if len(uniq_t) else 0
+        bytes_streamed = 0
+        # one sort up front; each chunk's queries are then a slice (the
+        # serving hot path must not rescan all Q queries per chunk)
+        q_by_pos = np.argsort(q_pos, kind="stable")
+        q_pos_sorted = q_pos[q_by_pos]
+        for ci in range(n_chunks):
+            take = u_order[ci * c:(ci + 1) * c]
+            fm_np = self._gather_rows(u_wid[take], u_row[take])
+            bytes_streamed += fm_np.nbytes
+            if len(take) < c:                 # stable chunk shape: pad with
+                fm_np = np.concatenate(       # stuck rows (never addressed)
+                    [fm_np, np.full((c - len(take), self.graph.n), -1,
+                                    np.int8)])
+            lo, hi = np.searchsorted(q_pos_sorted, [ci * c, (ci + 1) * c])
+            q_idx = q_by_pos[lo:hi]
+            qp = _pow2(len(q_idx))
+            rows_l = np.zeros(qp, np.int32)
+            s_l = np.zeros(qp, np.int32)
+            t_l = np.zeros(qp, np.int32)
+            valid = np.zeros(qp, bool)
+            rows_l[:len(q_idx)] = q_pos[q_idx] - ci * c
+            s_l[:len(q_idx)] = s_all[q_idx]
+            t_l[:len(q_idx)] = t_all[q_idx]
+            valid[:len(q_idx)] = True
+            cost, plen, fin = table_search_batch(
+                self.dg, jnp.asarray(fm_np), jnp.asarray(rows_l),
+                jnp.asarray(s_l), jnp.asarray(t_l), w_pad,
+                valid=jnp.asarray(valid), k_moves=k_moves,
+                max_steps=max_steps)
+            cost, plen, fin = map(np.asarray, (cost, plen, fin))
+            out_c[q_idx] = cost[:len(q_idx)]
+            out_p[q_idx] = plen[:len(q_idx)]
+            out_f[q_idx] = fin[:len(q_idx)]
+        self.last_stats = {
+            "n_queries": nq,
+            "distinct_targets": int(len(uniq_t)),
+            "row_chunks": n_chunks,
+            "bytes_streamed": int(bytes_streamed),
+        }
+        return out_c, out_p, out_f

@@ -1,0 +1,264 @@
+"""Fast-sweeping min-plus relaxation: CPD builds in O(turns), not O(hops).
+
+The sweep-per-hop relaxations (``bellman_ford``, ``shift_relax``) need
+~hop-diameter iterations — ``O(width+height)`` on a grid city, which makes
+the per-row build cost grow with graph size and walls the build off beyond
+~50k nodes (measured: 165 s full build at 224x224 on v5e).
+
+This module re-expresses the classic **fast sweeping method** as TPU scans.
+One "cycle" runs four Gauss-Seidel sweeps, one per diagonal quadrant
+ordering; each sweep processes anti-diagonals sequentially, so a distance
+value propagates along an ENTIRE monotone staircase path in a single sweep.
+Cycles needed ≈ the number of quadrant reversals / off-lattice hops on
+shortest paths — independent of the hop diameter.
+
+The TPU trick is the **skewed layout**: storing ``D_skew[y, x+y] = D[y, x]``
+makes every anti-diagonal a contiguous column, and both in-quadrant
+dependencies of column ``c`` — the same-row neighbor ``(x-1, y)`` and the
+cross-row neighbor ``(x, y-1)`` — live in column ``c-1``. A quadrant sweep
+is then one ``lax.scan`` over columns whose body is a tiny [H, B]
+elementwise min-plus update (carry = previous column, already updated:
+exactly Gauss-Seidel). The scan is **blocked**: ``_GROUP`` anti-diagonals
+per scan step, sequentially unrolled inside the body, so step-dispatch
+overhead amortizes while the Gauss-Seidel chain stays exact. Static
+shapes, no gathers in the scan body; the two skew/unskew row-gathers per
+sweep are O(N*B) once.
+
+Off-lattice edges are split by ``Graph.grid_split``: frequent constant
+id-offsets (arterial shortcuts) become shift planes relaxed by pad+slice —
+pure VPU adds, no gather, no [N, K, B] temp — and only true stragglers pay
+a (narrow) padded-ELL gather, both once per cycle. Correctness never
+depends on the grid assumption — only speed does: min-plus relaxation
+converges to the same fixed point under any update order, so the result is
+bit-identical to ``bellman_ford.dist_to_targets`` (tests pin this).
+
+Reference parity: this replaces the per-node Dijkstra sweeps of
+``make_cpd_auto`` (reference ``make_cpds.py:20``, ``README.md:88-95``)
+as the third and fastest build kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device_graph import JINF
+
+#: anti-diagonals per scan step (sequentially unrolled in the body)
+_GROUP = 8
+
+
+class GridGraph:
+    """Host-side bundle of ``Graph.grid_split`` outputs, device-ready.
+
+    ``width``/``height``/``shifts`` are static (baked into the compiled
+    program); the weight arrays are jit inputs, so one program serves any
+    graph with the same dimensions and shift signature.
+    """
+
+    def __init__(self, width, height, wl, wr, wd, wu, shifts, w_shift,
+                 src_left, dst_left, w_left):
+        self.width = int(width)
+        self.height = int(height)
+        n = self.width * self.height
+        on_grid = sum(int((np.asarray(a) < int(JINF)).sum())
+                      for a in (wl, wr, wd, wu))
+        on_shift = int((np.asarray(w_shift) < int(JINF)).sum())
+        left = int(len(np.asarray(src_left)))
+        total = on_grid + on_shift + left
+        self._coverage = 1.0 if total == 0 else (on_grid + on_shift) / total
+        # lattice share only: what the quadrant scans themselves serve.
+        # The auto build-method gate keys on this — a graph whose edges are
+        # all shift planes is correct under sweep but gains nothing from it
+        self._lattice_coverage = 0.0 if total == 0 else on_grid / total
+        self.wl = jnp.asarray(wl, jnp.int32).reshape(height, width)
+        self.wr = jnp.asarray(wr, jnp.int32).reshape(height, width)
+        self.wd = jnp.asarray(wd, jnp.int32).reshape(height, width)
+        self.wu = jnp.asarray(wu, jnp.int32).reshape(height, width)
+        self.shifts = tuple(int(s) for s in shifts)
+        self.w_shift = jnp.asarray(w_shift, jnp.int32)
+        self.src_left = jnp.asarray(src_left, jnp.int32)
+        self.dst_left = jnp.asarray(dst_left, jnp.int32)
+        self.w_left = jnp.asarray(w_left, jnp.int32)
+        self.n = n
+
+    @classmethod
+    def from_graph(cls, graph, width: int | None = None):
+        split = graph.grid_split(width)
+        if split is None:
+            return None
+        return cls(*split)
+
+    @property
+    def n_left(self) -> int:
+        return int(self.src_left.shape[0])
+
+    def coverage(self) -> float:
+        return self._coverage
+
+    def lattice_coverage(self) -> float:
+        return self._lattice_coverage
+
+
+@functools.lru_cache(maxsize=None)
+def _sweep_dist_fn(h: int, w: int, shifts: tuple, n_left: int,
+                   max_iters: int):
+    n = h * w
+    ca = w + h - 1                      # anti-diagonal count, both skews
+    ca_pad = -(-ca // _GROUP) * _GROUP  # blocked-scan padding (tail INF)
+    limit = (n - 1) if max_iters == 0 else max_iters
+    shift_pad = max((abs(s) for s in shifts), default=0)
+
+    ys = jnp.arange(h, dtype=jnp.int32)[:, None]        # [H, 1]
+    cols = jnp.arange(ca_pad, dtype=jnp.int32)[None, :]  # [1, CApad]
+    # layout A: col = x + y          layout B: col = x - y + (h-1)
+    x_a = cols - ys
+    x_b = cols - (h - 1) + ys
+    ok_a, xc_a = (x_a >= 0) & (x_a < w), jnp.clip(x_a, 0, w - 1)
+    ok_b, xc_b = (x_b >= 0) & (x_b < w), jnp.clip(x_b, 0, w - 1)
+    xs_plain = jnp.arange(w, dtype=jnp.int32)[None, :]
+    c_of_plain_a = xs_plain + ys                        # [H, W]
+    c_of_plain_b = xs_plain - ys + (h - 1)
+
+    def skew_w(w_hw, xc, ok):          # [H, W] weights -> [CApad, H]
+        sk = jnp.take_along_axis(w_hw, xc, axis=1)
+        return jnp.where(ok, sk, JINF).T
+
+    def to_skew(d, xc, ok):            # [H, W, B] -> [CApad, H, B]
+        sk = jnp.take_along_axis(d, xc[:, :, None], axis=1)
+        return jnp.swapaxes(jnp.where(ok[:, :, None], sk, JINF), 0, 1)
+
+    def from_skew(sk, c_plain):        # [CApad, H, B] -> [H, W, B]
+        return jnp.take_along_axis(jnp.swapaxes(sk, 0, 1),
+                                   c_plain[:, :, None], axis=1)
+
+    def row_down(prev):                # value of row y-1 aligned to row y
+        return jnp.concatenate(
+            [jnp.full_like(prev[:1], JINF), prev[:-1]], axis=0)
+
+    def row_up(prev):                  # value of row y+1 aligned to row y
+        return jnp.concatenate(
+            [prev[1:], jnp.full_like(prev[:1], JINF)], axis=0)
+
+    def sweep(d, xc, ok, c_plain, w_same, w_cross, cross, reverse):
+        """One quadrant Gauss-Seidel sweep: blocked scan over diagonals."""
+        sk = to_skew(d, xc, ok)
+        g = _GROUP
+        blk = lambda a: a.reshape(ca_pad // g, g, *a.shape[1:])  # noqa: E731
+
+        def step(prev, inp):
+            cur, wsm, wcr = inp        # [G,H,B], [G,H], [G,H]
+            out = [None] * g
+            order = range(g - 1, -1, -1) if reverse else range(g)
+            for gi in order:
+                via = jnp.minimum(
+                    jnp.minimum(wsm[gi][:, None] + prev, JINF),
+                    jnp.minimum(wcr[gi][:, None] + cross(prev), JINF))
+                prev = jnp.minimum(cur[gi], via)
+                out[gi] = prev
+            return prev, jnp.stack(out)
+
+        init = jnp.full(sk.shape[1:], JINF, jnp.int32)
+        _, out = jax.lax.scan(step, init,
+                              (blk(sk), blk(w_same), blk(w_cross)),
+                              reverse=reverse)
+        return from_skew(out.reshape(ca_pad, *out.shape[2:]), c_plain)
+
+    def relax_shifts(flat, w_shift):   # [N, B] pad+slice shift planes
+        if not shifts:
+            return flat
+        dp = jnp.pad(flat, ((shift_pad, shift_pad), (0, 0)),
+                     constant_values=JINF)
+        acc = flat
+        for si, s in enumerate(shifts):
+            sh = jax.lax.slice_in_dim(dp, shift_pad + s, shift_pad + s + n,
+                                      axis=0)
+            acc = jnp.minimum(acc,
+                              jnp.minimum(w_shift[si][:, None] + sh, JINF))
+        return acc
+
+    @jax.jit
+    def dist_to_targets_sweep(wl, wr, wd, wu, w_shift, src_left, dst_left,
+                              w_left, targets):
+        b = targets.shape[0]
+        valid = targets >= 0
+        t_safe = jnp.where(valid, targets, 0)
+        flat0 = jnp.full((n, b), JINF, jnp.int32)
+        flat0 = flat0.at[t_safe, jnp.arange(b)].set(
+            jnp.where(valid, jnp.int32(0), JINF))
+        d0 = flat0.reshape(h, w, b)
+
+        # skewed per-layout weight planes (computed once, loop-invariant)
+        wl_a, wd_a = skew_w(wl, xc_a, ok_a), skew_w(wd, xc_a, ok_a)
+        wr_a, wu_a = skew_w(wr, xc_a, ok_a), skew_w(wu, xc_a, ok_a)
+        wl_b, wu_b = skew_w(wl, xc_b, ok_b), skew_w(wu, xc_b, ok_b)
+        wr_b, wd_b = skew_w(wr, xc_b, ok_b), skew_w(wd, xc_b, ok_b)
+
+        def off_lattice(d):
+            """Shortcut shift planes + straggler scatter-min, once per
+            cycle: shortcut edges reseed the next cycle\'s sweeps."""
+            if not shifts and not n_left:
+                return d
+            flat = d.reshape(n, b)
+            flat = relax_shifts(flat, w_shift)
+            if n_left:
+                via = jnp.minimum(w_left[:, None] + flat[dst_left, :], JINF)
+                flat = flat.at[src_left, :].min(via)
+            return flat.reshape(h, w, b)
+
+        def cycle(d):
+            # quadrant (+,+): deps (x-1,y) same-row, (x,y-1) row below
+            d = sweep(d, xc_a, ok_a, c_of_plain_a, wl_a, wd_a, row_down,
+                      reverse=False)
+            # quadrant (-,-): deps (x+1,y), (x,y+1)
+            d = sweep(d, xc_a, ok_a, c_of_plain_a, wr_a, wu_a, row_up,
+                      reverse=True)
+            # quadrant (+,-): deps (x-1,y), (x,y+1)
+            d = sweep(d, xc_b, ok_b, c_of_plain_b, wl_b, wu_b, row_up,
+                      reverse=False)
+            # quadrant (-,+): deps (x+1,y), (x,y-1)
+            d = sweep(d, xc_b, ok_b, c_of_plain_b, wr_b, wd_b, row_down,
+                      reverse=True)
+            return off_lattice(d)
+
+        def cond(st):
+            i, _, changed = st
+            return changed & (i < limit)
+
+        def body(st):
+            i, d, _ = st
+            nd = cycle(d)
+            return i + 1, nd, jnp.any(nd < d)
+
+        # data-derived seed: varying under shard_map, True iff any valid
+        # target row exists (an all-padding chunk converges in zero cycles)
+        seed = jnp.any(flat0 < JINF)
+        _, d, _ = jax.lax.while_loop(cond, body, (jnp.int32(0), d0, seed))
+        return d.reshape(n, b).T
+
+    return dist_to_targets_sweep
+
+
+def dist_to_targets_sweep(gg: GridGraph, targets, max_iters: int = 0):
+    """int32 [B, N] of d(x → targets[b]) via fast-sweeping scans.
+
+    Bit-identical to ``bellman_ford.dist_to_targets`` (same min-plus fixed
+    point; tests pin equality). ``max_iters`` bounds the CYCLE count
+    (each cycle = 4 quadrant sweeps + 1 off-lattice relax); 0 = converge.
+    """
+    fn = _sweep_dist_fn(gg.height, gg.width, gg.shifts, gg.n_left,
+                        max_iters)
+    return fn(gg.wl, gg.wr, gg.wd, gg.wu, gg.w_shift, gg.src_left,
+              gg.dst_left, gg.w_left, jnp.asarray(targets, jnp.int32))
+
+
+def build_fm_columns_sweep(dg, gg: GridGraph, targets, max_iters: int = 0):
+    """CPD build via fast sweeping + the shared first-move extraction
+    (tie-break identical to the ELL and shift paths)."""
+    from .bellman_ford import first_move_from_dist
+
+    dist = dist_to_targets_sweep(gg, targets, max_iters=max_iters)
+    return first_move_from_dist(dg, jnp.asarray(targets, jnp.int32), dist)

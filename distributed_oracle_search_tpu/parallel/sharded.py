@@ -55,37 +55,46 @@ def pad_targets(controller, dtype=np.int32) -> np.ndarray:
 
 @functools.lru_cache(maxsize=None)
 def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
-              with_dists: bool, shift_sig: tuple | None = None):
-    """One compiled sharded builder for both relaxation kernels.
+              with_dists: bool, kind: str = "ell",
+              kernel_sig: tuple | None = None):
+    """One compiled sharded builder for all three relaxation kernels.
 
-    ``shift_sig = (shifts, n, k_left)`` switches the distance stage to the
-    gather-free shift relaxation (extra replicated operands); None uses
-    the padded-ELL gather. Everything else — shardings, target layout,
-    first-move extraction, with_dists outputs — is shared, so the two
-    paths cannot drift.
+    ``kind`` selects the distance stage: ``"sweep"`` (fast-sweeping grid
+    scans, sig ``(h, w, shifts, n_left)``), ``"shift"`` (gather-free shift
+    relaxation, sig ``(shifts, n, k_left)``) or ``"ell"`` (padded-ELL
+    gather, no sig). Extra kernel operands arrive replicated. Everything
+    else — shardings, target layout, first-move extraction, with_dists
+    outputs — is shared, so the paths cannot drift.
     """
     from ..ops.bellman_ford import dist_to_targets, first_move_from_dist
+    from ..ops.grid_sweep import _sweep_dist_fn
     from ..ops.shift_relax import _dist_fn
 
     tgt_shard = NamedSharding(mesh, P(None, WORKER_AXIS))
     out_shard = NamedSharding(mesh, P(WORKER_AXIS, None, None))
     rep = replicated(mesh)
     outs = (out_shard, out_shard) if with_dists else out_shard
-    n_shift_ops = 3 if shift_sig is not None else 0
-    shift_dist = (_dist_fn(*shift_sig, max_iters)
-                  if shift_sig is not None else None)
+    if kind == "sweep":
+        n_kernel_ops = 8
+        kernel_dist = _sweep_dist_fn(*kernel_sig, max_iters)
+    elif kind == "shift":
+        n_kernel_ops = 3
+        kernel_dist = _dist_fn(*kernel_sig, max_iters)
+    else:
+        n_kernel_ops = 0
+        kernel_dist = None
 
     @functools.partial(
         jax.jit,
-        in_shardings=(rep, *([rep] * n_shift_ops), tgt_shard),
+        in_shardings=(rep, *([rep] * n_kernel_ops), tgt_shard),
         out_shardings=outs)
     def _build(dg, *ops_and_tgt):
-        *shift_ops, tgt_bw = ops_and_tgt
+        *kernel_ops, tgt_bw = ops_and_tgt
         # tgt_bw: [B, W] — worker on the minor axis so each device owns a
         # column; transpose+flatten into the row-sharded batch
         tgts = tgt_bw.T.reshape(-1)
-        if shift_dist is not None:
-            dist = shift_dist(*shift_ops, tgts)
+        if kernel_dist is not None:
+            dist = kernel_dist(*kernel_ops, tgts)
         else:
             dist = dist_to_targets(dg, tgts, max_iters=max_iters)
         fm = first_move_from_dist(dg, tgts, dist)
@@ -100,7 +109,7 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
 def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
                      mesh: Mesh, chunk: int = 0,
                      max_iters: int = 0, with_dists: bool = False,
-                     sg=None):
+                     kernel=None):
     """Build the full sharded CPD: int8 [W, R, N], axis 0 on ``worker``.
 
     ``chunk`` bounds per-device live distance rows (0 = whole shard at
@@ -114,20 +123,28 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
     walk at all — one gather answers d(s→t) (SURVEY.md §5: "distance-only
     answers need no extraction").
 
-    ``sg``: optional ``ops.shift_relax.ShiftGraph`` — switches the
-    relaxation to the gather-free shift path (3.4x faster on the bench
-    city; identical results).
+    ``kernel``: optional ``(kind, structure)`` from
+    ``models.cpd.pick_build_kernel`` — selects the fast-sweeping /
+    shift / ELL distance stage (default ELL).
     """
     w, r = targets_wr.shape
     if mesh.shape[WORKER_AXIS] != w:
         raise ValueError(
             f"targets rows ({w}) != mesh worker axis "
             f"({mesh.shape[WORKER_AXIS]})")
-    if sg is not None:
-        fn = _build_fn(mesh, w, max_iters, with_dists,
-                       shift_sig=(sg.shifts, sg.n, sg.k_left))
+    kind, st = kernel if kernel is not None else ("ell", None)
+    if kind == "sweep":
+        fn = _build_fn(mesh, w, max_iters, with_dists, kind="sweep",
+                       kernel_sig=(st.height, st.width, st.shifts,
+                                   st.n_left))
         build = lambda dg_, t_: fn(  # noqa: E731
-            dg_, sg.w_shift, sg.nbr_left, sg.w_left, t_)
+            dg_, st.wl, st.wr, st.wd, st.wu, st.w_shift, st.src_left,
+            st.dst_left, st.w_left, t_)
+    elif kind == "shift":
+        fn = _build_fn(mesh, w, max_iters, with_dists, kind="shift",
+                       kernel_sig=(st.shifts, st.n, st.k_left))
+        build = lambda dg_, t_: fn(  # noqa: E731
+            dg_, st.w_shift, st.nbr_left, st.w_left, t_)
     else:
         build = _build_fn(mesh, w, max_iters, with_dists)
     if chunk <= 0 or chunk >= r:

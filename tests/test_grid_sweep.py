@@ -1,0 +1,101 @@
+"""Fast-sweeping build: bit-parity with the ELL relaxation, grid
+detection, and the sharded build path (SURVEY.md §7 stage 3)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import synth_city_graph
+from distributed_oracle_search_tpu.data.graph import Graph
+from distributed_oracle_search_tpu.models.cpd import (
+    CPDOracle, pick_build_kernel,
+)
+from distributed_oracle_search_tpu.ops import DeviceGraph
+from distributed_oracle_search_tpu.ops.bellman_ford import (
+    build_fm_columns, dist_to_targets,
+)
+from distributed_oracle_search_tpu.ops.grid_sweep import (
+    GridGraph, build_fm_columns_sweep, dist_to_targets_sweep,
+)
+from distributed_oracle_search_tpu.parallel import DistributionController
+from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+
+
+@pytest.mark.parametrize("side,seed", [(8, 7), (16, 3), (24, 0)])
+def test_sweep_dist_bit_identical(side, seed):
+    g = synth_city_graph(side, side, seed=seed)
+    gg = GridGraph.from_graph(g)
+    assert gg is not None
+    dg = DeviceGraph.from_graph(g)
+    tg = jnp.asarray(np.r_[np.arange(min(48, g.n)), -1, -1], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(dist_to_targets_sweep(gg, tg)),
+        np.asarray(dist_to_targets(dg, tg)))
+
+
+def test_sweep_fm_matches_ell():
+    g = synth_city_graph(12, 9, seed=11)
+    gg = GridGraph.from_graph(g)
+    dg = DeviceGraph.from_graph(g)
+    tg = jnp.asarray(np.r_[np.arange(40), -1], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(build_fm_columns_sweep(dg, gg, tg)),
+        np.asarray(build_fm_columns(dg, tg)))
+
+
+def test_grid_split_coverage_and_stragglers():
+    g = synth_city_graph(16, 16, seed=2)
+    gg = GridGraph.from_graph(g)
+    # synthetic city is grid + constant-offset shortcuts: near-total
+    # coverage, stragglers only from border clipping
+    assert gg.coverage() > 0.99
+    n_struct = (int((np.asarray(gg.w_shift) < 10 ** 9).sum())
+                + sum(int((np.asarray(a) < 10 ** 9).sum())
+                      for a in (gg.wl, gg.wr, gg.wd, gg.wu)))
+    assert n_struct + gg.n_left == g.m
+
+
+def test_non_grid_graph_gets_low_coverage_not_wrong_answers():
+    # a star graph has no lattice structure: the split still works (it is
+    # permissive — stragglers keep correctness), but coverage is too low
+    # for auto to ever pick sweep, and the sweep result stays exact
+    n = 12
+    src = np.r_[np.zeros(n - 1, np.int64), np.arange(1, n)]
+    dst = np.r_[np.arange(1, n), np.zeros(n - 1, np.int64)]
+    g = Graph(np.arange(n), np.arange(n), src, dst,
+              np.full(2 * (n - 1), 5, np.int32))
+    gg = GridGraph.from_graph(g)
+    if gg is not None:
+        from distributed_oracle_search_tpu.models.cpd import (
+            SWEEP_COVERAGE_MIN,
+        )
+        assert gg.lattice_coverage() < SWEEP_COVERAGE_MIN
+        dg = DeviceGraph.from_graph(g)
+        tg = jnp.asarray(np.arange(n), jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(dist_to_targets_sweep(gg, tg)),
+            np.asarray(dist_to_targets(dg, tg)))
+
+
+def test_pick_build_kernel_policies():
+    g = synth_city_graph(10, 10, seed=4)
+    kind, st = pick_build_kernel(g, "sweep")
+    assert kind == "sweep" and isinstance(st, GridGraph)
+    kind, _ = pick_build_kernel(g, "shift")
+    assert kind == "shift"
+    kind, st = pick_build_kernel(g, "ell")
+    assert kind == "ell" and st is None
+    # auto on a small grid stays with shift (sweep pays off above
+    # SWEEP_MIN_NODES only)
+    kind, _ = pick_build_kernel(g, "auto")
+    assert kind == "shift"
+    with pytest.raises(ValueError, match="unknown build method"):
+        pick_build_kernel(g, "bogus")
+
+
+def test_sharded_sweep_build_matches_auto(toy_graph):
+    dc = DistributionController("tpu", None, 8, toy_graph.n)
+    mesh = make_mesh(n_workers=8)
+    a = CPDOracle(toy_graph, dc, mesh=mesh).build(chunk=16, method="sweep")
+    b = CPDOracle(toy_graph, dc, mesh=mesh).build(chunk=16, method="ell")
+    np.testing.assert_array_equal(np.asarray(a.fm), np.asarray(b.fm))

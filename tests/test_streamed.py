@@ -1,0 +1,71 @@
+"""Streamed serving: disk-index row-streaming must match the resident
+oracle exactly (same walk kernel, different memory plan)."""
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import (
+    synth_city_graph, synth_scenario, synth_diff,
+)
+from distributed_oracle_search_tpu.models.cpd import (
+    CPDOracle, build_worker_shard, write_index_manifest,
+)
+from distributed_oracle_search_tpu.models.streamed import StreamedCPDOracle
+from distributed_oracle_search_tpu.parallel import DistributionController
+from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def stream_setup(tmp_path_factory):
+    outdir = str(tmp_path_factory.mktemp("cpd-index"))
+    g = synth_city_graph(16, 12, seed=5)
+    dc = DistributionController("mod", 4, 4, g.n)
+    for wid in range(4):
+        build_worker_shard(g, dc, wid, outdir, chunk=64)
+    write_index_manifest(outdir, dc)
+    queries = synth_scenario(g.n, 300, seed=6)
+    resident = CPDOracle(g, dc, mesh=make_mesh(n_workers=4)).load(outdir)
+    return g, dc, outdir, queries, resident
+
+
+def test_streamed_matches_resident_free_flow(stream_setup):
+    g, dc, outdir, queries, resident = stream_setup
+    st = StreamedCPDOracle(g, dc, outdir, row_chunk=37)  # force many chunks
+    c_r, p_r, f_r = resident.query(queries)
+    c_s, p_s, f_s = st.query(queries)
+    np.testing.assert_array_equal(c_s, c_r)
+    np.testing.assert_array_equal(p_s, p_r)
+    np.testing.assert_array_equal(f_s, f_r)
+    stats = st.last_stats
+    assert stats["n_queries"] == len(queries)
+    assert stats["row_chunks"] == -(-stats["distinct_targets"] // 37)
+    assert stats["bytes_streamed"] == stats["distinct_targets"] * g.n
+
+
+def test_streamed_matches_resident_diffed(stream_setup):
+    g, dc, outdir, queries, resident = stream_setup
+    w_diff = g.weights_with_diff(synth_diff(g, frac=0.2, seed=7))
+    st = StreamedCPDOracle(g, dc, outdir, row_chunk=64)
+    c_r, p_r, f_r = resident.query(queries, w_query=w_diff)
+    c_s, p_s, f_s = st.query(queries, w_query=w_diff)
+    np.testing.assert_array_equal(c_s, c_r)
+    np.testing.assert_array_equal(p_s, p_r)
+    np.testing.assert_array_equal(f_s, f_r)
+
+
+def test_streamed_k_moves_budget(stream_setup):
+    g, dc, outdir, queries, resident = stream_setup
+    st = StreamedCPDOracle(g, dc, outdir, row_chunk=128)
+    c_r, p_r, f_r = resident.query(queries, k_moves=3)
+    c_s, p_s, f_s = st.query(queries, k_moves=3)
+    np.testing.assert_array_equal(c_s, c_r)
+    np.testing.assert_array_equal(p_s, p_r)
+    np.testing.assert_array_equal(f_s, f_r)
+    assert (np.asarray(p_s) <= 3).all()
+
+
+def test_streamed_rejects_mismatched_controller(stream_setup):
+    g, dc, outdir, _, _ = stream_setup
+    other = DistributionController("mod", 2, 2, g.n)
+    with pytest.raises(ValueError, match="was built with"):
+        StreamedCPDOracle(g, other, outdir)
