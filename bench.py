@@ -8,11 +8,24 @@ scenario phase, so > 1.0 means beating the target.
 
 The reference's own data files are absent from its snapshot, so the workload
 is a deterministic synthetic city of comparable structure (two-way street
-grid + arterials; see ``data/synth.py``). Scale via env:
+grid + arterials; see ``data/synth.py``). Sections (env-gated):
 
-    BENCH_WIDTH/BENCH_HEIGHT  grid size        (default 96x96 ≈ 9.2k nodes)
-    BENCH_QUERIES             scenario size    (default 50_000)
-    BENCH_CHUNK               build batch rows (default 512)
+  main       96x96 city (9.2k nodes): build + walk/diff/dist campaigns
+  table      pointer-doubling amortization path       (BENCH_TABLE=0 skips)
+  scale      320x320 city (102,400 nodes), single chip: one full worker
+             shard built with the fast-sweeping kernel, then streamed
+             row-chunk serving from the on-disk index
+                                                      (BENCH_SCALE=0 skips)
+  weak       build-time weak scaling over a virtual 1/2/4/8-device CPU
+             mesh (subprocess)                        (BENCH_WEAK=0 skips)
+
+Roofline accounting: the walk is scalar-gather-bound, so the bench
+calibrates the device's achievable gather rate with a micro-kernel of the
+same shape and reports achieved vs peak (utilization) — q/s alone cannot
+say whether a number is good.
+
+Scale knobs: BENCH_WIDTH/HEIGHT, BENCH_QUERIES, BENCH_CHUNK,
+BENCH_SCALE_SIDE, BENCH_SCALE_QUERIES.
 
 Prints exactly ONE JSON line to stdout; progress goes to stderr.
 """
@@ -21,12 +34,94 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _calibrate_gather(n: int, q: int, iters: int = 64):
+    """Peak scalar-gather rate (elements/s) with the walk's access shape:
+    a while_loop of unrolled dependent [Q]-from-[N] gathers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    a = jnp.asarray(np.random.default_rng(0).integers(0, n, n), jnp.int32)
+    idx0 = jnp.asarray(np.random.default_rng(1).integers(0, n, q), jnp.int32)
+
+    @jax.jit
+    def run(idx):
+        def body(st):
+            i, x = st
+            for _ in range(8):
+                x = a[x]                      # dependent gather chain
+            return i + 1, x
+
+        return jax.lax.while_loop(lambda st: st[0] < iters, body,
+                                  (jnp.int32(0), idx))[1]
+
+    run(idx0).block_until_ready()             # compile
+    t0 = time.perf_counter()
+    run(idx0).block_until_ready()
+    dt = time.perf_counter() - t0
+    return q * 8 * iters / dt
+
+
+def _calibrate_hbm(mb: int = 512):
+    """Streaming HBM bandwidth (bytes/s touched) via y = x + 1."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.zeros(mb * (1 << 20) // 4, jnp.int32)
+    f = jax.jit(lambda v: v + 1)
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    f(x).block_until_ready()
+    dt = time.perf_counter() - t0
+    return 2 * x.size * 4 / dt                 # read + write
+
+
+def _weak_scaling(side: int, rows: int, chunk: int):
+    """Build-time vs worker count on a virtual CPU mesh (subprocess so the
+    TPU-pinned parent process cannot leak in). Same TOTAL rows each run."""
+    code = f"""
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np
+from distributed_oracle_search_tpu.data import synth_city_graph
+from distributed_oracle_search_tpu.models.cpd import CPDOracle
+from distributed_oracle_search_tpu.parallel import DistributionController
+from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+g = synth_city_graph({side}, {side}, seed=0)
+out = {{}}
+for w in (1, 2, 4, 8):
+    dc = DistributionController("tpu", None, w, g.n)
+    mesh = make_mesh(n_workers=w)
+    o = CPDOracle(g, dc, mesh=mesh)
+    o.build(chunk={chunk})                      # warm-up: compile
+    o = CPDOracle(g, dc, mesh=mesh)
+    t0 = time.perf_counter()
+    o.build(chunk={chunk})
+    jax.block_until_ready(o.fm)
+    out[str(w)] = round(time.perf_counter() - t0, 3)
+print(json.dumps(out))
+"""
+    res = subprocess.run([sys.executable, "-c", code], cwd=os.path.dirname(
+        os.path.abspath(__file__)), capture_output=True, text=True,
+        timeout=900)
+    if res.returncode != 0:
+        log(f"weak-scaling subprocess failed: {res.stderr[-500:]}")
+        return {}
+    return json.loads(res.stdout.strip().splitlines()[-1])
 
 
 def main() -> None:
@@ -40,7 +135,9 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - cache is best-effort
         log(f"compilation cache unavailable: {e}")
 
-    from distributed_oracle_search_tpu.data import synth_city_graph, synth_scenario
+    from distributed_oracle_search_tpu.data import (
+        synth_city_graph, synth_scenario, synth_diff,
+    )
     from distributed_oracle_search_tpu.models.cpd import CPDOracle
     from distributed_oracle_search_tpu.parallel import DistributionController
     from distributed_oracle_search_tpu.parallel.mesh import make_mesh
@@ -73,7 +170,6 @@ def main() -> None:
         f"{g.n * g.n / t_build.interval / 1e9:.2f} G entries/s)")
 
     # congestion diff for the perturbed round (reference: one round/diff)
-    from distributed_oracle_search_tpu.data import synth_diff
     dsrc, ddst, dw = synth_diff(g, frac=0.1, seed=2)
     w_diff = g.weights_with_diff((dsrc, ddst, dw))
 
@@ -96,8 +192,9 @@ def main() -> None:
         cost, plen, finished = oracle.query(queries)
     n_fin = int(finished.sum())
     qps = n_queries / t_scen.interval
+    mean_plen = float(plen.mean())
     log(f"walk free-flow: {n_queries} in {t_scen} -> {qps:,.0f} q/s; "
-        f"finished {n_fin}/{n_queries}, mean plen {plen.mean():.1f}")
+        f"finished {n_fin}/{n_queries}, mean plen {mean_plen:.1f}")
     assert n_fin == n_queries, "benchmark correctness gate failed"
 
     with Timer() as t_diff:
@@ -112,6 +209,22 @@ def main() -> None:
     assert (cost_g == cost).all(), "dist fast path must match the walk"
     log(f"dist gather:   {n_queries} in {t_dist} -> "
         f"{n_queries / t_dist.interval:,.0f} q/s")
+
+    # ---- roofline: the walk does ~3 scalar gathers per step per query
+    # (fm slot, per-slot weight, next node); compare achieved rate to a
+    # calibrated dependent-gather micro-kernel of the same shape
+    peak_gather = _calibrate_gather(g.n, n_queries)
+    hbm_bw = _calibrate_hbm()
+    # the lock-step walk runs max-plen steps for the batch; gathers issued
+    # scale with batch width x steps (halted lanes still occupy lanes)
+    steps_run = float(plen.max())
+    achieved_gather = n_queries * mean_plen * 3 / t_scen.interval
+    issued_gather = n_queries * steps_run * 3 / t_scen.interval
+    log(f"roofline: peak gather {peak_gather / 1e6:,.0f} M elem/s, "
+        f"useful {achieved_gather / 1e6:,.0f} "
+        f"({achieved_gather / peak_gather:.0%}), issued "
+        f"{issued_gather / 1e6:,.0f} ({issued_gather / peak_gather:.0%}); "
+        f"HBM {hbm_bw / 1e9:,.0f} GB/s")
 
     # pointer-doubling amortization path: whole-shard cost tables for the
     # DIFFED weights, then gather-speed answers. Costs O(R*N*log L)
@@ -133,6 +246,98 @@ def main() -> None:
             "table_prepare_seconds": round(t_prep.interval, 3),
             "table_queries_per_sec": round(n_queries / t_tab.interval, 1),
         }
+        del tables
+
+    # ---- scale section: 102k-node city, single chip. One complete worker
+    # shard (div/8) built with the fast-sweeping kernel and served
+    # STREAMED from the on-disk block files — the serving mode for indexes
+    # that exceed HBM (full fm at this scale: N^2 = 10.5 GB single-shard).
+    scale_stats = {}
+    if os.environ.get("BENCH_SCALE", "1") != "0":
+        import shutil
+        import tempfile
+
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.models.streamed import (
+            StreamedCPDOracle,
+        )
+
+        side = int(os.environ.get("BENCH_SCALE_SIDE", 320))
+        sq = int(os.environ.get("BENCH_SCALE_QUERIES", 20_000))
+        g2 = synth_city_graph(side, side, seed=0)
+        w_scale = 8
+        per_w = -(-g2.n // w_scale)
+        dc2 = DistributionController("div", per_w, w_scale, g2.n)
+        outdir = tempfile.mkdtemp(prefix="dos-scale-")
+        try:
+            log(f"scale: n={g2.n} building worker 0 shard "
+                f"({dc2.n_owned(0)} rows, sweep kernel)...")
+            # warm-up: compile the sweep program at the build chunk shape
+            # (persistent-cached across runs) so the timed build is
+            # steady-state like every other section
+            from distributed_oracle_search_tpu.models.cpd import (
+                pick_build_kernel,
+            )
+            from distributed_oracle_search_tpu.ops import DeviceGraph
+            from distributed_oracle_search_tpu.ops.grid_sweep import (
+                build_fm_columns_sweep,
+            )
+            _, gg2 = pick_build_kernel(g2, "sweep")
+            dg2 = DeviceGraph.from_graph(g2)
+            jax.block_until_ready(build_fm_columns_sweep(
+                dg2, gg2, np.arange(512, dtype=np.int32)))
+            # chunk=512: the sweep kernel's while-body holds several
+            # skewed [CA, H, B] buffers; 512 rows is the measured safe
+            # working set on a 16 GB chip at this graph size
+            with Timer() as t_b2:
+                build_worker_shard(g2, dc2, 0, outdir, chunk=512,
+                                   method="sweep")
+            rows0 = dc2.n_owned(0)
+            rps2 = rows0 / t_b2.interval
+            full_est = g2.n / rps2
+            write_index_manifest(outdir, dc2, workers=[0])
+            log(f"scale build: {rows0} rows in {t_b2} -> {rps2:,.0f} "
+                f"rows/s ({rps2 * g2.n / 1e9:.2f} G entries/s), full-index "
+                f"extrapolation {full_est:,.0f}s")
+
+            rng = np.random.default_rng(3)
+            q2 = np.stack([rng.integers(0, g2.n, sq),
+                           rng.integers(0, rows0, sq)], axis=1)
+            st = StreamedCPDOracle(g2, dc2, outdir, row_chunk=4096)
+            st.query(q2[:256])                 # warm-up: compile
+            with Timer() as t_q2:
+                c2, p2, f2 = st.query(q2)
+            assert bool(f2.all()), "scale campaign left unfinished queries"
+            sqps = sq / t_q2.interval
+            mbps = st.last_stats["bytes_streamed"] / t_q2.interval / 1e6
+            log(f"scale streamed: {sq} queries in {t_q2} -> {sqps:,.0f} "
+                f"q/s; streamed {st.last_stats['bytes_streamed'] / 1e6:,.0f}"
+                f" MB ({mbps:,.0f} MB/s incl. walk)")
+            scale_stats = {
+                "scale_nodes": g2.n,
+                "scale_build_rows": rows0,
+                "scale_build_seconds": round(t_b2.interval, 2),
+                "scale_build_rows_per_sec": round(rps2, 1),
+                "scale_full_build_est_seconds": round(full_est, 1),
+                "scale_stream_queries_per_sec": round(sqps, 1),
+                "scale_stream_mb": round(
+                    st.last_stats["bytes_streamed"] / 1e6, 1),
+            }
+        finally:
+            shutil.rmtree(outdir, ignore_errors=True)
+
+    # ---- weak scaling: same total rows over 1/2/4/8 virtual CPU devices
+    weak_stats = {}
+    if os.environ.get("BENCH_WEAK", "1") != "0":
+        log("weak scaling (virtual CPU mesh subprocess)...")
+        weak = _weak_scaling(side=64, rows=4096, chunk=512)
+        if weak:
+            base = weak.get("1")
+            log("weak scaling build seconds: " + ", ".join(
+                f"W={w}: {s}s (x{base / s:.2f})" for w, s in weak.items()))
+            weak_stats = {"weak_scaling_build_seconds": weak}
 
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     print(json.dumps({
@@ -150,6 +355,16 @@ def main() -> None:
             **table_stats,
             "cpd_build_seconds": round(t_build.interval, 2),
             "cpd_rows_per_sec": round(rows_per_s, 1),
+            "roofline": {
+                "peak_gather_meps": round(peak_gather / 1e6, 1),
+                "walk_useful_gather_meps": round(achieved_gather / 1e6, 1),
+                "walk_issued_gather_meps": round(issued_gather / 1e6, 1),
+                "walk_gather_utilization": round(
+                    issued_gather / peak_gather, 3),
+                "hbm_stream_gbps": round(hbm_bw / 1e9, 1),
+            },
+            **scale_stats,
+            **weak_stats,
             "devices": len(devices),
             "platform": devices[0].platform,
         },

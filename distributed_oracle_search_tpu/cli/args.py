@@ -56,6 +56,12 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
     path = p.add_argument_group("search")
     path.add_argument("-k", "--k-moves", type=int, default=-1,
                       help="Number of moves to extract; -1 = all.")
+    path.add_argument("--extract", action="store_true",
+                      help="Materialize each query's first k-moves path "
+                           "nodes (needs -k > 0): workers write "
+                           "<queryfile>.paths, the campaign collects "
+                           "paths.csv. Wire extension; the reference "
+                           "computed prefixes but never returned them.")
     path.add_argument("--h-scale", default=1.0, type=float,
                       help="Heuristic tolerance factor for A*.")
     path.add_argument("--f-scale", default=0.0, type=float,

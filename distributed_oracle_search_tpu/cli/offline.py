@@ -82,6 +82,12 @@ def make_parts(reqs: np.ndarray, args, num_parts: int) -> list[np.ndarray]:
     elif args.alloc is not None:
         bounds = np.asarray(args.alloc, np.int64)
         idx = np.searchsorted(bounds, t, side="right")
+        if (idx == len(bounds)).any():
+            # loud failure, matching DistributionController: silently
+            # dropping out-of-range targets would shrink campaign totals
+            bad = int(t[idx == len(bounds)][0])
+            raise ValueError(
+                f"alloc bounds {list(bounds)} do not cover target {bad}")
         parts = [reqs[idx == i] for i in range(len(bounds))]
     else:  # by range: equal-count chunks of the request list
         parts = [chunk for chunk in np.array_split(reqs, num_parts)]

@@ -38,7 +38,8 @@ from ..data.formats import read_diff, read_scen, xy_node_count
 from ..parallel.partition import DistributionController
 from ..transport.fifo import answer_fifo_path, command_fifo_path, fan_out
 from ..transport.wire import (
-    Request, RuntimeConfig, STATS_HEADER, StatsRow, write_query_file,
+    Request, RuntimeConfig, STATS_HEADER, StatsRow, paths_file_for,
+    read_paths_file, write_query_file,
 )
 from ..transport import fifo as fifo_transport
 from ..utils.config import ClusterConfig, test_config
@@ -51,11 +52,15 @@ log = get_logger(__name__)
 def runtime_config(args) -> RuntimeConfig:
     """Per-batch engine knobs from CLI args (parity: reference
     ``process_query.py:149-160``)."""
+    extract = bool(getattr(args, "extract", False))
+    if extract and args.k_moves <= 0:
+        raise SystemExit("--extract needs -k/--k-moves > 0")
     return RuntimeConfig(
         hscale=args.h_scale, fscale=args.f_scale, time=get_time_ns(args),
         itrs=args.itrs, k_moves=args.k_moves, threads=args.omp,
         verbose=args.verbose, debug=args.debug,
         thread_alloc=args.thread_alloc, no_cache=args.no_cache,
+        extract=extract,
     )
 
 
@@ -75,7 +80,16 @@ def effective_partition(conf: ClusterConfig, args):
 
 def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
     """All diff rounds in-process on the mesh; per-worker rows recovered
-    from the routed results."""
+    from the routed results.
+
+    Per-worker timing semantics: one fused sharded XLA call answers the
+    whole round, so a per-worker wall clock does not exist. Each row's
+    ``t_astar``/``t_search`` (and ``t_receive``/``t_prepare``) carry the
+    worker's SHARE of the round interval, apportioned by walked moves
+    (by batch size when no moves) — rows of a round sum to the measured
+    round time, so downstream tooling that aggregates per-worker columns
+    gets campaign-true totals (tests pin this).
+    """
     from ..data.graph import Graph
     from ..models.cpd import CPDOracle
     from ..parallel.mesh import make_mesh
@@ -92,6 +106,7 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
 
     owner = dc.worker_of(queries[:, 1])
     stats = []
+    paths = None
     for diff in diffs:
         with Timer() as prep:
             w_query = (None if diff == "-"
@@ -100,6 +115,10 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
             cost, plen, fin = oracle.query(
                 queries, w_query=w_query, k_moves=args.k_moves,
                 active_worker=args.worker)
+        active = (np.ones(len(queries), bool) if args.worker == -1
+                  else owner == args.worker)
+        total_moves = int(plen[active].sum())
+        total_size = int(active.sum())
         rows = []
         for wid in range(dc.maxworker):
             if args.worker != -1 and wid != args.worker:
@@ -108,19 +127,29 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
             size = int(mask.sum())
             if size == 0:
                 continue
+            moves = int(plen[mask].sum())
+            share = (moves / total_moves if total_moves
+                     else size / max(total_size, 1))
             row = StatsRow(
-                n_expanded=int(plen[mask].sum()),
+                n_expanded=moves,
                 n_touched=size,
-                plen=int(plen[mask].sum()),
+                plen=moves,
                 finished=int(fin[mask].sum()),
-                t_receive=prep.interval,
-                t_astar=search.interval,
-                t_search=search.interval,
+                t_receive=prep.interval * share,
+                t_astar=search.interval * share,
+                t_search=search.interval * share,
             )
-            rows.append(row.as_list(t_prepare=prep.interval,
+            rows.append(row.as_list(t_prepare=prep.interval * share,
                                     t_partition=0.0, size=size))
         stats.append(rows)
-    return stats
+    if getattr(args, "extract", False) and args.k_moves > 0:
+        # moves always follow the FREE-FLOW first-move table (reference
+        # semantics), so path prefixes are diff-invariant: extract once
+        nodes, moves = oracle.query_paths(queries, k=args.k_moves,
+                                          active_worker=args.worker)
+        paths = np.concatenate(
+            [queries, moves[:, None], nodes], axis=1)
+    return stats, paths
 
 
 # ----------------------------------------------------------------- host path
@@ -154,6 +183,7 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
     timeout = max(fifo_transport.DEFAULT_TIMEOUT,
                   (get_time_ns(args) / 1e9) * 10)
     stats = []
+    paths = None
     for diff in diffs:
         jobs = [(conf.workers[wid], wid, part) for wid, part in
                 sorted(groups.items())]
@@ -161,7 +191,24 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
             j[0], j[1], j[2], rconf, conf.nfs, diff,
             t_partition=t_partition, timeout=timeout))
         stats.append(rows)
-    return stats
+        if rconf.extract and paths is None:
+            # prefixes follow free-flow moves -> diff-invariant; collect
+            # each worker's .paths file from the first round only
+            parts = []
+            for host, wid, part in jobs:
+                pfile = paths_file_for(
+                    os.path.join(conf.nfs, f"query.{host}{wid}"))
+                try:
+                    nodes, moves = read_paths_file(pfile)
+                except (OSError, ValueError) as e:
+                    log.error("no paths from worker %d (%s); skipping", wid,
+                              e)
+                    continue
+                parts.append(np.concatenate(
+                    [part, moves[:, None], nodes], axis=1))
+            if parts:
+                paths = np.concatenate(parts, axis=0)
+    return stats, paths
 
 
 # ------------------------------------------------------------------- driver
@@ -188,10 +235,10 @@ def run(conf: ClusterConfig, args):
         initialize_from_conf(conf)
     with Timer() as t_process:
         if use_tpu:
-            stats = run_tpu(conf, args, queries, dc, diffs)
+            stats, paths = run_tpu(conf, args, queries, dc, diffs)
         else:
-            stats = run_host(conf, args, queries, dc, diffs,
-                             t_partition=t_workload.interval)
+            stats, paths = run_host(conf, args, queries, dc, diffs,
+                                    t_partition=t_workload.interval)
 
     data = {
         "num_queries": int(len(queries)),
@@ -200,18 +247,27 @@ def run(conf: ClusterConfig, args):
         "t_workload": t_workload.interval,
         "t_process": t_process.interval,
     }
-    return data, stats
+    return data, stats, paths
 
 
-def output(data, stats, args) -> None:
+def output(data, stats, args, paths=None) -> None:
     """Print, or write the artifact trio (reference
-    ``process_query.py:196-239`` with the CSV bug fixed)."""
+    ``process_query.py:196-239`` with the CSV bug fixed), plus
+    ``paths.csv`` when ``--extract`` collected prefixes: one row per
+    query, ``s, t, moves, n0..nk`` (free-flow, diff-invariant)."""
     if args.output is None:
         print(data)
         print(STATS_HEADER)
         for i, expe in enumerate(stats):
             for row in expe:
                 print(i, row)
+        if paths is not None:
+            k = paths.shape[1] - 4
+            print(["s", "t", "moves"] + [f"n{j}" for j in range(k + 1)])
+            for row in paths[:10]:
+                print(list(row))
+            if len(paths) > 10:
+                print(f"... {len(paths)} path rows (use -o DIR for all)")
         return
     dirname = args.output
     os.makedirs(dirname, exist_ok=True)
@@ -224,6 +280,13 @@ def output(data, stats, args) -> None:
         writer.writerow(STATS_HEADER)
         writer.writerows([i, *row] for i, expe in enumerate(stats)
                          for row in expe)
+    if paths is not None:
+        k = paths.shape[1] - 4
+        with open(os.path.join(dirname, "paths.csv"), "w") as f:
+            writer = csv.writer(f, quoting=csv.QUOTE_MINIMAL)
+            writer.writerow(["s", "t", "moves"]
+                            + [f"n{j}" for j in range(k + 1)])
+            writer.writerows(paths.tolist())
 
 
 def test(args):
@@ -236,8 +299,8 @@ def test(args):
 
     conf = test_config(n_workers=len(jax.devices()))
     ensure_synth_dataset(os.path.dirname(conf.xy_file) or "./data")
-    data, stats = run(conf, args)
-    output(data, stats, args)
+    data, stats, paths = run(conf, args)
+    output(data, stats, args, paths)
     return data, stats
 
 
@@ -258,8 +321,8 @@ def main(argv=None) -> int:
             test(args)
             return 0
         conf = ClusterConfig.load(args.c)
-        data, stats = run(conf, args)
-        output(data, stats, args)
+        data, stats, paths = run(conf, args)
+        output(data, stats, args, paths)
     return 0
 
 

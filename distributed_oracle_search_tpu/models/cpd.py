@@ -40,7 +40,8 @@ from ..parallel.mesh import (
 from ..parallel.partition import DistributionController
 from ..parallel.sharded import (
     build_tables_sharded, pad_targets, build_fm_sharded,
-    query_dist_sharded, query_sharded, query_tables_sharded,
+    query_dist_sharded, query_paths_sharded, query_sharded,
+    query_tables_sharded,
 )
 
 INDEX_VERSION = 1
@@ -149,10 +150,10 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     os.makedirs(outdir, exist_ok=True)
     owned = dc.owned(wid)
     bs = dc.block_size
-    step = chunk if chunk > 0 else max(len(owned), 1)
-    # round the build step to a whole number of blocks so file granularity
-    # and compute granularity line up
-    step = max(bs, (step // bs) * bs)
+    # compute granularity (device working set) is independent of the file
+    # granularity: each block file is assembled from `chunk`-row kernel
+    # calls, so a 16k-row block never forces a 16k-row device batch
+    chunk = chunk if chunk > 0 else max(len(owned), 1)
     n_blocks = (len(owned) + bs - 1) // bs
     # only the missing blocks are computed — a restart after a partial
     # build pays exactly for what is not yet on disk
@@ -163,40 +164,46 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         return []
     kind, structure = pick_build_kernel(graph, method)
     dg = DeviceGraph.from_graph(graph)
-    written = []
-    per_step = step // bs
-    for g0 in range(0, len(missing), per_step):
-        group = missing[g0:g0 + per_step]
-        blocks = [owned[bid * bs: min((bid + 1) * bs, len(owned))]
-                  for bid in group]
-        tgts = np.concatenate(blocks)
-        pad = np.full(step, -1, np.int32)  # fixed shape -> one compile
+
+    def compute(tgts: np.ndarray) -> np.ndarray:
+        pad = np.full(chunk, -1, np.int32)  # fixed shape -> one compile
         pad[:len(tgts)] = tgts
         if kind == "sweep":
-            fm = np.asarray(build_fm_columns_sweep(dg, structure, pad,
-                                                   max_iters=max_iters))
+            fm = build_fm_columns_sweep(dg, structure, pad,
+                                        max_iters=max_iters)
         elif kind == "shift":
-            fm = np.asarray(build_fm_columns_shift(dg, structure, pad,
-                                                   max_iters=max_iters))
+            fm = build_fm_columns_shift(dg, structure, pad,
+                                        max_iters=max_iters)
         else:
-            fm = np.asarray(build_fm_columns(dg, jnp.asarray(pad),
-                                             max_iters=max_iters))
-        off = 0
-        for bid, blk in zip(group, blocks):
-            fname = shard_block_name(wid, bid)
-            np.save(os.path.join(outdir, fname), fm[off:off + len(blk)])
-            written.append(fname)
-            off += len(blk)
+            fm = build_fm_columns(dg, jnp.asarray(pad), max_iters=max_iters)
+        return np.asarray(fm)[:len(tgts)]
+
+    written = []
+    for bid in missing:
+        blk = owned[bid * bs: min((bid + 1) * bs, len(owned))]
+        parts = [compute(blk[i:i + chunk])
+                 for i in range(0, len(blk), chunk)]
+        fname = shard_block_name(wid, bid)
+        np.save(os.path.join(outdir, fname),
+                parts[0] if len(parts) == 1 else np.concatenate(parts))
+        written.append(fname)
     return written
 
 
 def write_index_manifest(outdir: str, dc: DistributionController,
-                         rows_per_worker: int | None = None) -> dict:
-    """Write ``index.json`` describing a complete per-block CPD index (the
-    head runs this after all workers' builds finish)."""
+                         rows_per_worker: int | None = None,
+                         workers=None) -> dict:
+    """Write ``index.json`` describing a per-block CPD index (the head
+    runs this after all workers' builds finish).
+
+    ``workers``: optional subset of worker ids to enumerate — a PARTIAL
+    index for single-worker serving (the analog of the reference's ``-w``
+    filter): streamed/resident serving then answers only queries whose
+    target those workers own; other workers' rows load as "stuck".
+    """
     files = []
     bs = dc.block_size
-    for wid in range(dc.maxworker):
+    for wid in (range(dc.maxworker) if workers is None else workers):
         n_owned = dc.n_owned(wid)
         for bid in range((n_owned + bs - 1) // bs):
             fname = shard_block_name(wid, bid)
@@ -461,6 +468,32 @@ class CPDOracle:
         out_p[active] = p[sd[active], sw[active], sq[active]]
         out_f[active] = f[sd[active], sw[active], sq[active]]
         return out_c, out_p, out_f
+
+    def query_paths(self, queries: np.ndarray, k: int,
+                    active_worker: int = -1):
+        """Materialize each query's first ``k`` path nodes (the
+        reference's ``--k-moves`` extraction, reference ``args.py:31-36``).
+
+        Returns ``(nodes, moves)``: int64 ``[Q, k+1]`` — row q starts at
+        ``s``, the last node repeats once the path ends — and the number
+        of real moves taken (≤ k). Queries outside ``active_worker`` get
+        all-zero rows, matching :meth:`query`'s filter semantics.
+        """
+        if self.fm is None:
+            raise RuntimeError("build() or load() before query_paths()")
+        if k <= 0:
+            raise ValueError("k must be positive")
+        r_arr, s_arr, t_arr, valid, scatter = self.route(
+            queries, active_worker)
+        nodes, moves = map(np.asarray, query_paths_sharded(
+            self.dg, self.fm, r_arr, s_arr, t_arr, self.mesh, k=k))
+        nq = len(queries)
+        active, sd, sw, sq = scatter
+        out_n = np.zeros((nq, k + 1), np.int64)
+        out_m = np.zeros(nq, np.int64)
+        out_n[active] = nodes[sd[active], sw[active], sq[active]]
+        out_m[active] = moves[sd[active], sw[active], sq[active]]
+        return out_n, out_m
 
     def query_dist(self, queries: np.ndarray, active_worker: int = -1):
         """Free-flow fast path: answer d(s → t) by one sharded gather.

@@ -131,6 +131,13 @@ class StreamedCPDOracle:
         # serving hot path must not rescan all Q queries per chunk)
         q_by_pos = np.argsort(q_pos, kind="stable")
         q_pos_sorted = q_pos[q_by_pos]
+        # ONE padded query shape for the whole campaign (the max chunk,
+        # rounded up): per-chunk pow2 padding would compile a fresh walk
+        # program per distinct chunk size
+        if n_chunks:
+            bounds = np.searchsorted(
+                q_pos_sorted, np.arange(n_chunks + 1) * c)
+            qp_all = _pow2(int(np.diff(bounds).max()))
         for ci in range(n_chunks):
             take = u_order[ci * c:(ci + 1) * c]
             fm_np = self._gather_rows(u_wid[take], u_row[take])
@@ -139,9 +146,9 @@ class StreamedCPDOracle:
                 fm_np = np.concatenate(       # stuck rows (never addressed)
                     [fm_np, np.full((c - len(take), self.graph.n), -1,
                                     np.int8)])
-            lo, hi = np.searchsorted(q_pos_sorted, [ci * c, (ci + 1) * c])
+            lo, hi = bounds[ci], bounds[ci + 1]
             q_idx = q_by_pos[lo:hi]
-            qp = _pow2(len(q_idx))
+            qp = qp_all
             rows_l = np.zeros(qp, np.int32)
             s_l = np.zeros(qp, np.int32)
             t_l = np.zeros(qp, np.int32)

@@ -240,6 +240,42 @@ def query_tables_sharded(tables, t_rows, s, valid, mesh: Mesh):
     return _query_table_fn(mesh)(cost, plen, fin, rows_d, s_d, v_d)
 
 
+# --------------------------------------------------------------------- paths
+
+@functools.lru_cache(maxsize=None)
+def _paths_fn(mesh: Mesh, k: int):
+    from ..ops.table_search import extract_paths
+
+    q3 = P(DATA_AXIS, WORKER_AXIS, None)
+
+    def _local(dg, fm_local, rows, s, t):
+        shape = s.shape
+        nodes, plen = extract_paths(dg, fm_local[0], rows.reshape(-1),
+                                    s.reshape(-1), t.reshape(-1), k=k)
+        return (nodes.reshape(*shape, k + 1), plen.reshape(shape))
+
+    sm = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3),
+        out_specs=(P(DATA_AXIS, WORKER_AXIS, None, None), q3),
+    )
+    return jax.jit(sm)
+
+
+def query_paths_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
+                        t_rows: np.ndarray, s: np.ndarray, t: np.ndarray,
+                        mesh: Mesh, k: int):
+    """Materialize k-move path prefixes for routed [D, W, Q] queries.
+
+    Returns ``(nodes [D, W, Q, k+1], moves [D, W, Q])`` — each shard scans
+    only its own queries against its own fm rows (the reference's
+    ``--k-moves`` extraction, reference ``args.py:31-36``, batched).
+    """
+    qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
+    args = [jax.device_put(jnp.asarray(a), qs) for a in (t_rows, s, t)]
+    return _paths_fn(mesh, k)(dg, fm_wrn, *args)
+
+
 # --------------------------------------------------------------------- query
 
 @functools.lru_cache(maxsize=None)

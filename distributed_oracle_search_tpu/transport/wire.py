@@ -46,7 +46,17 @@ STATS_HEADER = ["expe", *ENGINE_STAT_FIELDS, *HEAD_STAT_FIELDS]
 
 @dataclasses.dataclass
 class RuntimeConfig:
-    """Per-batch engine knobs (wire line 1)."""
+    """Per-batch engine knobs (wire line 1).
+
+    ``extract`` is a wire extension beyond the reference's key set: with
+    ``k_moves > 0`` it asks the engine to materialize each query's first
+    ``k_moves`` path nodes into ``<queryfile>.paths`` next to the query
+    file (the reference's ``--k-moves`` "number of moves to extract",
+    reference ``args.py:31-36``, never shipped the nodes anywhere; here
+    they ride the shared dir, keeping the stats CSV wire unchanged).
+    Servers that predate the key ignore it (``from_json`` filters unknown
+    keys symmetrically).
+    """
 
     hscale: float = 1.0
     fscale: float = 0.0
@@ -58,6 +68,7 @@ class RuntimeConfig:
     debug: bool = False
     thread_alloc: int = 0
     no_cache: bool = False
+    extract: bool = False
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -144,6 +155,37 @@ class StatsRow:
         """Full head-side row (engine fields + appended head fields)."""
         return ([getattr(self, f) for f in ENGINE_STAT_FIELDS]
                 + [t_prepare, t_partition, size])
+
+
+# ------------------------------------------------------------ paths files
+
+def paths_file_for(queryfile: str) -> str:
+    """Where a server materializes extracted path prefixes for a batch."""
+    return queryfile + ".paths"
+
+
+def write_paths_file(path: str, nodes: np.ndarray, plen: np.ndarray) -> None:
+    """``Q k`` header, then per query: ``<moves taken> n0 n1 ... nk``
+    (node ids; after the path ends the last node repeats — the layout of
+    ``ops.extract_paths``)."""
+    nodes = np.asarray(nodes)
+    plen = np.asarray(plen).reshape(-1, 1)
+    with open(path, "w") as f:
+        f.write(f"{nodes.shape[0]} {nodes.shape[1] - 1}\n")
+        np.savetxt(f, np.concatenate([plen, nodes], axis=1), fmt="%d")
+
+
+def read_paths_file(path: str) -> tuple[np.ndarray, np.ndarray]:
+    """Returns ``(nodes [Q, k+1], plen [Q])``."""
+    with open(path) as f:
+        q, k = (int(x) for x in f.readline().split())
+        if q == 0:
+            return np.zeros((0, k + 1), np.int64), np.zeros(0, np.int64)
+        out = np.loadtxt(f, dtype=np.int64, ndmin=2)
+    if out.shape != (q, k + 2):
+        raise ValueError(f"{path}: header says {(q, k + 2)}, "
+                         f"found {out.shape}")
+    return out[:, 1:], out[:, 0]
 
 
 # ----------------------------------------------------------- query files

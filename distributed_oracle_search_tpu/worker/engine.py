@@ -72,6 +72,8 @@ class ShardEngine:
             self.fm = None
         self.dg = DeviceGraph.from_graph(graph)
         self._weight_cache: dict[str, object] = {}
+        #: path prefixes of the most recent extract batch (see answer())
+        self.last_paths: tuple[np.ndarray, np.ndarray] | None = None
 
     # ------------------------------------------------------------ weights
     def _weights_for(self, difffile: str, no_cache: bool):
@@ -93,15 +95,26 @@ class ShardEngine:
     def answer(self, queries: np.ndarray, config: RuntimeConfig,
                difffile: str = "-") -> tuple[np.ndarray, np.ndarray,
                                              np.ndarray, StatsRow]:
-        """Answer a batch; returns (cost, plen, finished, stats)."""
+        """Answer a batch; returns (cost, plen, finished, stats).
+
+        With ``config.extract`` and ``k_moves > 0`` the extracted path
+        prefixes land on ``self.last_paths`` as ``(nodes [Q, k+1],
+        moves [Q])`` — the server materializes them into the batch's
+        ``.paths`` file (wire extension, see ``transport.wire``).
+        """
         import jax
         import jax.numpy as jnp
-        from ..ops.table_search import table_search_batch
+        from ..ops.table_search import extract_paths, table_search_batch
 
         t0 = time.perf_counter()
+        self.last_paths = None
         w_pad = self._weights_for(difffile, config.no_cache)
         nq = len(queries)
         if nq == 0:
+            if config.extract and config.k_moves > 0:
+                self.last_paths = (
+                    np.zeros((0, config.k_moves + 1), np.int64),
+                    np.zeros(0, np.int64))
             return (np.zeros(0, np.int64), np.zeros(0, np.int64),
                     np.zeros(0, bool), StatsRow())
         # pad to the next power of two: stable shapes, no recompiles as the
@@ -144,6 +157,12 @@ class ShardEngine:
             jax.block_until_ready(fin)
             if deadline is not None and time.perf_counter() > deadline:
                 break
+        if config.extract and config.k_moves > 0:
+            nodes, moves = extract_paths(
+                self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
+                jnp.asarray(t), k=config.k_moves)
+            self.last_paths = (np.asarray(nodes[:nq], np.int64),
+                               np.asarray(moves[:nq], np.int64))
         t2 = time.perf_counter()
 
         cost = np.asarray(cost[:nq], np.int64)

@@ -26,7 +26,9 @@ import numpy as np
 
 from ..data.graph import Graph
 from ..parallel.partition import DistributionController
-from ..transport.wire import Request, StatsRow, read_query_file
+from ..transport.wire import (
+    Request, StatsRow, paths_file_for, read_query_file, write_paths_file,
+)
 from ..transport.fifo import command_fifo_path
 from ..utils.config import ClusterConfig
 from ..utils.log import get_logger, set_verbosity
@@ -63,6 +65,11 @@ class FifoServer:
         queries = read_query_file(req.queryfile)
         _, _, _, stats = self.engine.answer(queries, req.config,
                                             req.difffile)
+        if self.engine.last_paths is not None:
+            # extraction rides the shared dir, not the stats FIFO (wire
+            # extension: transport.wire.paths_file_for)
+            write_paths_file(paths_file_for(req.queryfile),
+                             *self.engine.last_paths)
         return stats
 
     def serve_forever(self) -> None:
@@ -90,11 +97,45 @@ class FifoServer:
                     # head blocked on `cat answer`; send a failure row
                     log.exception("batch failed: %s", e)
                     stats = StatsRow.failed()
-                with open(req.answerfifo, "w") as f:
-                    f.write(stats.encode_wire() + "\n")
+                self._reply(req.answerfifo, stats.encode_wire() + "\n")
         finally:
             if os.path.exists(self.command_fifo):
                 os.remove(self.command_fifo)
+
+    #: how long to wait for the head to open its answer-FIFO reader
+    REPLY_DEADLINE_S = 30.0
+
+    def _reply(self, answerfifo: str, line: str) -> None:
+        """Write the stats line without ever wedging the server: a
+        blocking ``open(fifo, 'w')`` would hang forever if the head's
+        ``cat <answer>`` was killed before opening its end. Non-blocking
+        open with a bounded deadline; drop the reply (logged) if no
+        reader appears."""
+        import errno
+        import time as _time
+
+        deadline = _time.monotonic() + self.REPLY_DEADLINE_S
+        fd = -1
+        while fd < 0:
+            try:
+                fd = os.open(answerfifo, os.O_WRONLY | os.O_NONBLOCK)
+            except OSError as e:
+                if e.errno not in (errno.ENXIO, errno.ENOENT):
+                    log.error("cannot open %s: %s", answerfifo, e)
+                    return
+                if _time.monotonic() > deadline:
+                    log.error("no reader on %s within %.0fs; dropping "
+                              "reply", answerfifo, self.REPLY_DEADLINE_S)
+                    return
+                _time.sleep(0.05)
+        try:
+            # reader present: restore blocking mode for the write itself
+            import fcntl
+            fcntl.fcntl(fd, fcntl.F_SETFL,
+                        fcntl.fcntl(fd, fcntl.F_GETFL) & ~os.O_NONBLOCK)
+            os.write(fd, line.encode())
+        finally:
+            os.close(fd)
 
     def _answer_malformed(self, text: str) -> None:
         """Best effort: recover the answer FIFO path from line 2 of a
@@ -107,12 +148,8 @@ class FifoServer:
         if len(tokens) < 2:
             return
         answerfifo = tokens[1]
-        try:
-            if os.path.exists(answerfifo):
-                with open(answerfifo, "w") as f:
-                    f.write(StatsRow.failed().encode_wire() + "\n")
-        except OSError as e:
-            log.error("could not answer malformed request: %s", e)
+        if os.path.exists(answerfifo):
+            self._reply(answerfifo, StatsRow.failed().encode_wire() + "\n")
 
     def stop_file(self) -> None:
         """Write the stop token into our own FIFO (for another process)."""

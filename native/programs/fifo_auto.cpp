@@ -21,8 +21,10 @@
 // drivers cannot tell the two apart. --compress keeps the shard
 // run-length-encoded in memory (the reference's CPD compression trade).
 
+#include <fcntl.h>
 #include <omp.h>
 
+#include <cctype>
 #include <cerrno>
 #include <cstring>
 #include <fstream>
@@ -47,22 +49,93 @@ static double now_s() {
     return tv.tv_sec + tv.tv_usec * 1e-6;
 }
 
-// minimal flat-JSON number/bool extraction for the runtime-config line
-// (wire schema: transport/wire.py RuntimeConfig)
-static double json_num(const std::string& j, const std::string& key,
-                       double dflt) {
-    auto p = j.find("\"" + key + "\"");
-    if (p == std::string::npos) return dflt;
-    p = j.find(':', p);
-    if (p == std::string::npos) return dflt;
-    ++p;
-    while (p < j.size() && (j[p] == ' ' || j[p] == '\t')) ++p;
-    if (!j.compare(p, 4, "true")) return 1;
-    if (!j.compare(p, 5, "false")) return 0;
-    try {
-        return std::stod(j.substr(p));
-    } catch (...) { return dflt; }
+// ---- flat-JSON tokenizer for the runtime-config line (wire schema:
+// transport/wire.py RuntimeConfig). A real (if small) parser: strings are
+// skipped with escape handling, nested containers are skipped balanced,
+// numbers accept sign/decimal/exponent — so a key name appearing inside a
+// string value, or a string-typed knob, can never corrupt the numbers.
+// Values surface as doubles (true=1, false=0, null/strings absent).
+namespace flatjson {
+
+static void skip_ws(const std::string& j, size_t& p) {
+    while (p < j.size() && std::isspace(static_cast<unsigned char>(j[p])))
+        ++p;
 }
+
+static bool parse_string(const std::string& j, size_t& p,
+                         std::string* out) {
+    if (p >= j.size() || j[p] != '"') return false;
+    ++p;
+    std::string s;
+    while (p < j.size() && j[p] != '"') {
+        if (j[p] == '\\' && p + 1 < j.size()) { s += j[p + 1]; p += 2; }
+        else s += j[p++];
+    }
+    if (p >= j.size()) return false;
+    ++p;  // closing quote
+    if (out) *out = s;
+    return true;
+}
+
+static bool skip_container(const std::string& j, size_t& p) {
+    char open = j[p], close = open == '{' ? '}' : ']';
+    int depth = 0;
+    while (p < j.size()) {
+        if (j[p] == '"') { if (!parse_string(j, p, nullptr)) return false; continue; }
+        if (j[p] == open) ++depth;
+        else if (j[p] == close && --depth == 0) { ++p; return true; }
+        ++p;
+    }
+    return false;
+}
+
+// parse one top-level JSON object into key -> numeric value
+static std::map<std::string, double> parse(const std::string& j) {
+    std::map<std::string, double> out;
+    size_t p = 0;
+    skip_ws(j, p);
+    if (p >= j.size() || j[p] != '{') return out;
+    ++p;
+    while (true) {
+        skip_ws(j, p);
+        if (p < j.size() && j[p] == '}') break;
+        std::string key;
+        if (!parse_string(j, p, &key)) break;
+        skip_ws(j, p);
+        if (p >= j.size() || j[p] != ':') break;
+        ++p;
+        skip_ws(j, p);
+        if (p >= j.size()) break;
+        if (j[p] == '"') {                       // string value: skip
+            if (!parse_string(j, p, nullptr)) break;
+        } else if (j[p] == '{' || j[p] == '[') { // nested: skip balanced
+            if (!skip_container(j, p)) break;
+        } else if (!j.compare(p, 4, "true")) { out[key] = 1; p += 4; }
+        else if (!j.compare(p, 5, "false")) { out[key] = 0; p += 5; }
+        else if (!j.compare(p, 4, "null")) { p += 4; }
+        else {                                   // number
+            size_t q = p;
+            while (q < j.size() && (std::isdigit(
+                       static_cast<unsigned char>(j[q])) || j[q] == '-' ||
+                   j[q] == '+' || j[q] == '.' || j[q] == 'e' || j[q] == 'E'))
+                ++q;
+            try { out[key] = std::stod(j.substr(p, q - p)); } catch (...) {}
+            p = q;
+        }
+        skip_ws(j, p);
+        if (p < j.size() && j[p] == ',') { ++p; continue; }
+        break;
+    }
+    return out;
+}
+
+static double get(const std::map<std::string, double>& m,
+                  const std::string& key, double dflt) {
+    auto it = m.find(key);
+    return it == m.end() ? dflt : it->second;
+}
+
+}  // namespace flatjson
 
 struct Server {
     Graph g;
@@ -97,12 +170,19 @@ struct Server {
                        const std::string& queryfile,
                        const std::string& difffile) {
         double t0 = now_s();
-        int64_t k_moves = int64_t(json_num(cfg_json, "k_moves", -1));
-        int threads = int(json_num(cfg_json, "threads", 0));
-        bool no_cache = json_num(cfg_json, "no_cache", 0) != 0;
-        int64_t itrs = std::max<int64_t>(1, int64_t(json_num(cfg_json, "itrs", 1)));
-        double hscale = json_num(cfg_json, "hscale", 1.0);
-        double fscale = json_num(cfg_json, "fscale", 0.0);
+        auto cfg = flatjson::parse(cfg_json);
+        int64_t k_moves = int64_t(flatjson::get(cfg, "k_moves", -1));
+        int threads = int(flatjson::get(cfg, "threads", 0));
+        bool no_cache = flatjson::get(cfg, "no_cache", 0) != 0;
+        int64_t itrs =
+            std::max<int64_t>(1, int64_t(flatjson::get(cfg, "itrs", 1)));
+        double hscale = flatjson::get(cfg, "hscale", 1.0);
+        double fscale = flatjson::get(cfg, "fscale", 0.0);
+        // ns budget on the itrs repetition loop (wire parity with the
+        // Python ShardEngine: worker/engine.py deadline semantics)
+        double time_ns = flatjson::get(cfg, "time", 0);
+        bool extract = flatjson::get(cfg, "extract", 0) != 0 && k_moves > 0
+                       && alg == "table-search";
         const std::vector<int32_t>& wq = weights_for(difffile, no_cache);
         auto queries = load_query_file(queryfile);
         // routing invariant (same loud failure as the Python ShardEngine):
@@ -123,6 +203,7 @@ struct Server {
         double cpu = use_astar ? min_cost_per_unit(g, wq) : 0.0;
         SearchStats total;
         if (threads > 0) omp_set_num_threads(threads);
+        double deadline = time_ns > 0 ? t1 + time_ns * 1e-9 : 0.0;
         for (int64_t it = 0; it < itrs; ++it) {
             SearchStats round;
 #pragma omp parallel
@@ -149,6 +230,33 @@ struct Server {
                 round += local;
             }
             total = round;  // last iteration wins (wire parity with python)
+            if (deadline > 0 && now_s() > deadline) break;
+        }
+        if (extract) {
+            // wire extension (transport/wire.py paths_file_for): first
+            // k_moves path nodes per query into <queryfile>.paths —
+            // "Q k" header, then "<moves> n0 ... nk" per query, last
+            // node repeated once the path ends
+            std::ofstream pf(queryfile + ".paths");
+            pf << queries.size() << " " << k_moves << "\n";
+            for (auto& [s, t] : queries) {
+                int64_t row = dc.owned_idx[t];
+                int64_t x = s, moves = 0;
+                std::vector<int64_t> nodes{x};
+                for (int64_t k = 0; k < k_moves && x != t; ++k) {
+                    int8_t slot = shard.first_move(row, x);
+                    if (slot < 0) break;
+                    x = g.dst[g.out_edge_at(x, slot)];
+                    nodes.push_back(x);
+                    ++moves;
+                }
+                pf << moves;
+                for (int64_t k = 0; k <= k_moves; ++k)
+                    pf << " "
+                       << nodes[size_t(std::min<int64_t>(
+                              k, int64_t(nodes.size()) - 1))];
+                pf << "\n";
+            }
         }
         double t2 = now_s();
         char buf[256];
@@ -188,8 +296,37 @@ struct Server {
             } catch (...) {
                 reply = "FAIL";  // never leave the head blocked
             }
-            std::ofstream out(answerfifo);
-            out << reply << "\n";
+            reply += "\n";
+            // non-blocking open with a bounded deadline: if the head died
+            // before opening its `cat <answer>` reader, a blocking open
+            // would wedge this worker for every future request. Drop the
+            // reply (and log) if no reader appears in time.
+            double give_up = now_s() + 30.0;
+            int fd = -1;
+            while (fd < 0 && now_s() < give_up) {
+                fd = ::open(answerfifo.c_str(), O_WRONLY | O_NONBLOCK);
+                if (fd < 0) {
+                    if (errno != ENXIO && errno != ENOENT) break;
+                    ::usleep(50 * 1000);
+                }
+            }
+            if (fd < 0) {
+                std::fprintf(stderr,
+                             "fifo_auto: no reader on %s within 30s; "
+                             "dropping reply\n", answerfifo.c_str());
+                continue;
+            }
+            // reader present: clear O_NONBLOCK so the write itself blocks
+            // normally (a FIFO write after open may still fill the pipe)
+            ::fcntl(fd, F_SETFL, ::fcntl(fd, F_GETFL) & ~O_NONBLOCK);
+            size_t off = 0;
+            while (off < reply.size()) {
+                ssize_t k = ::write(fd, reply.data() + off,
+                                    reply.size() - off);
+                if (k <= 0) break;
+                off += size_t(k);
+            }
+            ::close(fd);
         }
     }
 };
@@ -209,8 +346,10 @@ static int real_main(int argc, char** argv) {
         };
         if (a == "--input") {
             input = next();
-            if (i + 1 < argc && argv[i + 1][0] != '-') diff = argv[++i];
-            else if (i + 1 < argc && std::strcmp(argv[i + 1], "-") == 0)
+            // optional diff operand: anything that is not a known flag —
+            // "--input g.xy -my-diff" must treat "-my-diff" as the diff
+            // path, not choke on the leading dash
+            if (i + 1 < argc && std::strncmp(argv[i + 1], "--", 2) != 0)
                 diff = argv[++i];
         } else if (a == "--partmethod") partmethod = next();
         else if (a == "--partkey") {

@@ -127,7 +127,7 @@ def test_fifo_auto_campaign(bins, dataset, tmp_path, monkeypatch, compress):
             assert time.time() < deadline, "fifo_auto never came up"
             time.sleep(0.05)
 
-        data, stats = pq.run(conf, parse_args(["--backend", "host"]))
+        data, stats, _paths = pq.run(conf, parse_args(["--backend", "host"]))
         queries = read_scen(conf.scenfile)
         assert data["num_queries"] == len(queries)
         for expe in stats:
@@ -179,7 +179,7 @@ def test_native_and_python_servers_interoperable(bins, dataset, tmp_path,
         while not all(os.path.exists(f) for f in fifos.values()):
             assert time.time() < deadline
             time.sleep(0.05)
-        data, stats = pq.run(conf, parse_args(["--backend", "host"]))
+        data, stats, _paths = pq.run(conf, parse_args(["--backend", "host"]))
         queries = read_scen(conf.scenfile)
         assert sum(r[6] for r in stats[0]) == len(queries)
     finally:
@@ -323,6 +323,203 @@ def test_fifo_auto_astar(bins, dataset, tmp_path):
         # optimal path lengths: plen sum must equal the oracle's hop counts
         # is not guaranteed (ties), but costs are checked via plen>0 and
         # the finished count; cost itself is not on the stats wire.
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def _start_native_server(bins, paths, idx, fifo, extra=()):
+    proc = subprocess.Popen(
+        [bins["fifo_auto"], "--input", paths["xy"], "--partmethod", "mod",
+         "--partkey", "2", "--workerid", "0", "--maxworker", "2",
+         "--outdir", idx, "--alg", "table-search", "--fifo", fifo,
+         *extra],
+        stderr=subprocess.DEVNULL)
+    deadline = time.time() + 15
+    while not os.path.exists(fifo):
+        assert time.time() < deadline, "fifo_auto never came up"
+        time.sleep(0.05)
+    return proc
+
+
+def _native_request(fifo, tmp_path, queries, cfg_json, tag="req"):
+    """Push one raw 2-line request; returns the reply line."""
+    from distributed_oracle_search_tpu.transport.wire import (
+        write_query_file,
+    )
+    qfile = str(tmp_path / f"{tag}.query")
+    afifo = str(tmp_path / f"{tag}.answer")
+    write_query_file(qfile, queries)
+    os.mkfifo(afifo)
+    try:
+        with open(fifo, "w") as f:
+            f.write(cfg_json + "\n" + f"{qfile} {afifo} -\n")
+        with open(afifo) as f:
+            return f.readline().strip(), qfile
+    finally:
+        os.unlink(afifo)
+
+
+@pytest.fixture(scope="module")
+def native_index(bins, dataset, tmp_path_factory):
+    datadir, paths = dataset
+    idx = str(tmp_path_factory.mktemp("nidx"))
+    for wid in range(2):
+        subprocess.run(
+            [bins["make_cpd_auto"], "--input", paths["xy"],
+             "--partmethod", "mod", "--partkey", "2",
+             "--workerid", str(wid), "--maxworker", "2", "--outdir", idx],
+            check=True, capture_output=True)
+    return paths, idx
+
+
+def test_native_extract_paths_parity(bins, native_index, tmp_path):
+    """Native --extract emits the same .paths file the Python engine
+    produces (golden vs the CPU oracle walk)."""
+    from distributed_oracle_search_tpu.data import Graph
+    from distributed_oracle_search_tpu.models.reference import (
+        first_move_to_target, table_search_walk,
+    )
+    from distributed_oracle_search_tpu.parallel import (
+        DistributionController,
+    )
+    from distributed_oracle_search_tpu.transport.wire import (
+        read_paths_file,
+    )
+
+    paths, idx = native_index
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n)
+    scen = read_scen(paths["scen"])
+    mine = scen[dc.worker_of(scen[:, 1]) == 0][:12]
+    fifo = str(tmp_path / "ex.fifo")
+    proc = _start_native_server(bins, paths, idx, fifo)
+    try:
+        cfg = ('{"hscale": 1.0, "fscale": 0.0, "time": 0, "itrs": 1, '
+               '"k_moves": 6, "threads": 1, "verbose": 0, "debug": false, '
+               '"thread_alloc": 0, "no_cache": false, "extract": true}')
+        reply, qfile = _native_request(fifo, tmp_path, mine, cfg, "ex")
+        assert reply != "FAIL"
+        nodes, moves = read_paths_file(qfile + ".paths")
+        assert nodes.shape == (len(mine), 7)
+        for (s, t), nrow, m in zip(mine, nodes, moves):
+            fm_col = first_move_to_target(g, int(t))
+            _, gm, _, path = table_search_walk(
+                g, lambda x, _t: fm_col[x], int(s), int(t), k_moves=6)
+            path = path + [path[-1]] * (7 - len(path))
+            assert m == min(gm, 6)
+            assert list(nrow) == path[:7]
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def test_native_json_parser_hardened(bins, native_index, tmp_path):
+    """Valid-but-awkward JSON configs the Python side could legally emit:
+    string values, scientific notation, key names inside strings, nested
+    containers — none may corrupt the parsed knobs."""
+    from distributed_oracle_search_tpu.data import Graph
+    from distributed_oracle_search_tpu.parallel import (
+        DistributionController,
+    )
+
+    paths, idx = native_index
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n)
+    scen = read_scen(paths["scen"])
+    mine = scen[dc.worker_of(scen[:, 1]) == 0][:8]
+    fifo = str(tmp_path / "fz.fifo")
+    proc = _start_native_server(bins, paths, idx, fifo)
+    nasty = [
+        # string value containing a known key name + escaped quote
+        ('{"note": "k_moves\\" bogus: 99", "k_moves": -1, "itrs": 1, '
+         '"threads": 1, "no_cache": false}'),
+        # scientific notation and + signs
+        '{"itrs": 1e0, "k_moves": -1, "time": 0E0, "threads": 1}',
+        # nested container values (future extension) skipped balanced
+        ('{"meta": {"k_moves": 77, "arr": [1, 2, "x]"]}, "k_moves": -1, '
+         '"itrs": 1, "threads": 1}'),
+        # null values and unicode escapes
+        '{"extra": null, "tag": "\\u0041", "k_moves": -1, "threads": 1}',
+    ]
+    try:
+        for i, cfg in enumerate(nasty):
+            reply, _ = _native_request(fifo, tmp_path, mine, cfg, f"fz{i}")
+            assert reply != "FAIL", f"config {i} failed: {cfg}"
+            fields = reply.split(",")
+            assert len(fields) == 10
+            assert int(fields[6]) == len(mine), \
+                f"config {i}: finished {fields[6]} != {len(mine)}"
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def test_native_time_budget_bounds_itrs(bins, native_index, tmp_path):
+    """`time` ns budget must break the itrs repetition loop (ADVICE wire-
+    parity gap): 1000 itrs with a 1ns budget returns ~immediately."""
+    from distributed_oracle_search_tpu.data import Graph
+    from distributed_oracle_search_tpu.parallel import (
+        DistributionController,
+    )
+
+    paths, idx = native_index
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n)
+    scen = read_scen(paths["scen"])
+    mine = scen[dc.worker_of(scen[:, 1]) == 0]
+    fifo = str(tmp_path / "tb.fifo")
+    proc = _start_native_server(bins, paths, idx, fifo)
+    try:
+        cfg = '{"itrs": 100000, "time": 1, "k_moves": -1, "threads": 1}'
+        t0 = time.time()
+        reply, _ = _native_request(fifo, tmp_path, mine, cfg, "tb")
+        elapsed = time.time() - t0
+        assert reply != "FAIL"
+        assert elapsed < 30, "time budget did not bound the itrs loop"
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def test_native_server_survives_dead_reader(bins, native_index, tmp_path):
+    """A request whose answer FIFO never gets a reader (head died) must
+    not wedge the server: the next request still gets served."""
+    from distributed_oracle_search_tpu.data import Graph
+    from distributed_oracle_search_tpu.parallel import (
+        DistributionController,
+    )
+    from distributed_oracle_search_tpu.transport.wire import (
+        write_query_file,
+    )
+
+    paths, idx = native_index
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n)
+    scen = read_scen(paths["scen"])
+    mine = scen[dc.worker_of(scen[:, 1]) == 0][:4]
+    fifo = str(tmp_path / "dr.fifo")
+    proc = _start_native_server(bins, paths, idx, fifo)
+    try:
+        # request 1: nonexistent answer fifo, nobody will ever read it.
+        # The server waits its bounded deadline (30s) then drops.
+        qfile = str(tmp_path / "dead.query")
+        write_query_file(qfile, mine)
+        with open(fifo, "w") as f:
+            f.write('{"itrs": 1, "threads": 1}\n'
+                    f"{qfile} {tmp_path}/nonexistent.answer -\n")
+        # request 2 must still be answered (within the drop deadline +
+        # margin)
+        t0 = time.time()
+        reply, _ = _native_request(fifo, tmp_path, mine,
+                                   '{"itrs": 1, "threads": 1}', "dr")
+        assert reply != "FAIL"
+        assert int(reply.split(",")[6]) == len(mine)
+        assert time.time() - t0 < 60
     finally:
         with open(fifo, "w") as fh:
             fh.write("__DOS_STOP__\n")
