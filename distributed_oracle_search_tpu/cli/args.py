@@ -116,6 +116,13 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                       help="Diff files for congestion; '-' = free flow.")
     fifo.add_argument("--no-cache", action="store_true",
                       help="Disable the workers' runtime cache.")
+    fifo.add_argument("--alg", default="table-search",
+                      choices=["table-search", "astar", "ch"],
+                      help="Serving algorithm for launched servers "
+                           "(make_fifos). The reference hard-codes "
+                           "table-search (make_fifos.py:20); astar serves "
+                           "the hscale/fscale family, ch the "
+                           "congestion-free contraction hierarchy.")
 
     new = p.add_argument_group("tpu (new in this framework)")
     new.add_argument("--backend", choices=["auto", "tpu", "host"],

@@ -12,8 +12,11 @@ shard, then blocks on its command FIFO ``/tmp/worker<wid>.fifo``.
   says so and exits 0 (launch host-mode servers with ``--backend host`` if
   you want FIFO transport against CPU shards anyway).
 
-The algorithm is table-search, as in the reference (hard-coded at
-``make_fifos.py:20``).
+The default algorithm is table-search, as in the reference (hard-coded
+there, reference ``make_fifos.py:20``); ``--alg astar`` launches
+hscale/fscale weighted-A* servers, ``--alg ch`` (native engine only)
+contraction-hierarchy servers — the congestion-free family of the
+reference's TODO (reference ``README.md:133``).
 """
 
 from __future__ import annotations
@@ -30,7 +33,8 @@ log = get_logger(__name__)
 
 def worker_server_cmd(wid: int, conf_path: str, verbose: int = 0,
                       engine: str = "python",
-                      conf: ClusterConfig | None = None) -> str:
+                      conf: ClusterConfig | None = None,
+                      alg: str = "table-search") -> str:
     if engine == "native":
         from ..utils.nativebin import require_binary
         assert conf is not None
@@ -42,18 +46,19 @@ def worker_server_cmd(wid: int, conf_path: str, verbose: int = 0,
                 f" --input {conf.xy_file} {diff}"
                 f" --partmethod {conf.partmethod} --partkey {partkey}"
                 f" --workerid {wid} --maxworker {conf.maxworker}"
-                f" --outdir {conf.outdir} --alg table-search")
+                f" --outdir {conf.outdir} --alg {alg}")
     cmd = (f"{sys.executable} -m distributed_oracle_search_tpu.worker.server"
-           f" -c {conf_path} --workerid {wid}")
+           f" -c {conf_path} --workerid {wid} --alg {alg}")
     if verbose:
         cmd += " -" + "v" * verbose
     return cmd
 
 
 def call_worker(wid: int, conf: ClusterConfig, conf_path: str,
-                verbose: int = 0, engine: str = "python"):
+                verbose: int = 0, engine: str = "python",
+                alg: str = "table-search"):
     host = conf.workers[wid]
-    cmd = worker_server_cmd(wid, conf_path, verbose, engine, conf)
+    cmd = worker_server_cmd(wid, conf_path, verbose, engine, conf, alg=alg)
     log.info("launch server w%d on %s: %s", wid, host, cmd)
     return launch(host, session_name("fifo", wid), cmd,
                   projectdir=conf.projectdir)
@@ -74,12 +79,16 @@ def main(argv=None) -> int:
     if conf_path is None:
         raise SystemExit("host-mode servers need a conf file (-c), "
                          "not -t test mode")
+    if args.alg == "ch" and args.engine != "native":
+        raise SystemExit("--alg ch is served by the native engine "
+                         "(contraction hierarchies, native/src/ch.hpp); "
+                         "add --engine native")
     procs = []
     for wid in range(conf.maxworker):
         if args.worker != -1 and wid != args.worker:
             continue
         proc = call_worker(wid, conf, conf_path, args.verbose,
-                           engine=args.engine)
+                           engine=args.engine, alg=args.alg)
         if proc is not None:
             procs.append((wid, proc))
     print(f"launched {conf.maxworker if args.worker == -1 else 1} "

@@ -41,6 +41,7 @@ from ..transport.wire import (
     Request, RuntimeConfig, STATS_HEADER, StatsRow, paths_file_for,
     read_paths_file, write_query_file,
 )
+from ..parallel.multihost import is_primary
 from ..transport import fifo as fifo_transport
 from ..utils.config import ClusterConfig, test_config
 from ..utils.log import get_logger, set_verbosity
@@ -300,7 +301,8 @@ def test(args):
     conf = test_config(n_workers=len(jax.devices()))
     ensure_synth_dataset(os.path.dirname(conf.xy_file) or "./data")
     data, stats, paths = run(conf, args)
-    output(data, stats, args, paths)
+    if is_primary():
+        output(data, stats, args, paths)
     return data, stats
 
 
@@ -322,7 +324,10 @@ def main(argv=None) -> int:
             return 0
         conf = ClusterConfig.load(args.c)
         data, stats, paths = run(conf, args)
-        output(data, stats, args, paths)
+        # multi-controller: every process runs the identical campaign;
+        # only process 0 writes/prints the shared artifacts
+        if is_primary():
+            output(data, stats, args, paths)
     return 0
 
 

@@ -47,6 +47,21 @@ from ..parallel.sharded import (
 INDEX_VERSION = 1
 
 
+def _host(x) -> np.ndarray:
+    """Sharded device result -> host numpy, multi-controller safe.
+
+    Single-process: a plain ``np.asarray`` (device transfer of the local
+    shards). With >1 JAX process the array spans non-addressable devices,
+    so it rides ``process_allgather`` instead — every controller gets the
+    identical global value, preserving the invariant that all processes
+    compute the same campaign results."""
+    if jax.process_count() > 1:
+        from ..parallel.multihost import gather_to_host
+
+        return gather_to_host(x)
+    return np.asarray(x)
+
+
 def shard_block_name(wid: int, bid: int) -> str:
     return f"cpd-w{wid:05d}-b{bid:05d}.npy"
 
@@ -294,11 +309,21 @@ class CPDOracle:
 
     # ------------------------------------------------------- persistence
     def save(self, outdir: str) -> None:
-        """Write the CPD index: one .npy per (worker, block) + manifest."""
+        """Write the CPD index: one .npy per (worker, block) + manifest.
+
+        Multi-controller safe: with >1 JAX process the sharded table is
+        allgathered (its shards live on non-addressable devices) and only
+        process 0 writes, so concurrent controllers never race on the
+        shared index directory."""
         if self.fm is None:
             raise RuntimeError("build() or load() before save()")
+        fm = _host(self.fm)
+        if jax.process_count() > 1:
+            from ..parallel.multihost import is_primary
+
+            if not is_primary():
+                return
         os.makedirs(outdir, exist_ok=True)
-        fm = np.asarray(self.fm)
         bs = self.dc.block_size
         for wid in range(self.dc.maxworker):
             n_owned = self.dc.n_owned(wid)
@@ -394,7 +419,7 @@ class CPDOracle:
         cost, plen, fin = query_sharded(
             self.dg, self.fm, r_arr, s_arr, t_arr, valid, w_pad, self.mesh,
             k_moves=k_moves, max_steps=max_steps)
-        cost, plen, fin = map(np.asarray, (cost, plen, fin))
+        cost, plen, fin = map(_host, (cost, plen, fin))
         nq = len(queries)
         active, sd, sw, sq = scatter
         out_c = np.zeros(nq, np.int64)
@@ -457,7 +482,7 @@ class CPDOracle:
         """
         r_arr, s_arr, t_arr, valid, scatter = self.route(
             queries, active_worker)
-        c, p, f = map(np.asarray, query_tables_sharded(
+        c, p, f = map(_host, query_tables_sharded(
             tables, r_arr, s_arr, valid, self.mesh))
         nq = len(queries)
         active, sd, sw, sq = scatter
@@ -485,7 +510,7 @@ class CPDOracle:
             raise ValueError("k must be positive")
         r_arr, s_arr, t_arr, valid, scatter = self.route(
             queries, active_worker)
-        nodes, moves = map(np.asarray, query_paths_sharded(
+        nodes, moves = map(_host, query_paths_sharded(
             self.dg, self.fm, r_arr, s_arr, t_arr, self.mesh, k=k))
         nq = len(queries)
         active, sd, sw, sq = scatter
@@ -508,7 +533,7 @@ class CPDOracle:
                 "distance table not resident; build(store_dists=True)")
         r_arr, s_arr, t_arr, valid, scatter = self.route(
             queries, active_worker)
-        cost = np.asarray(query_dist_sharded(self.dists, r_arr, s_arr,
+        cost = _host(query_dist_sharded(self.dists, r_arr, s_arr,
                                              self.mesh))
         nq = len(queries)
         active, sd, sw, sq = scatter

@@ -3,10 +3,11 @@ from .bellman_ford import dist_to_targets, first_move_from_dist, build_fm_column
 from .table_search import extract_paths, table_search_batch
 from .pointer_doubling import doubled_tables, lookup_tables
 from .shift_relax import ShiftGraph, dist_to_targets_shift
+from .batched_astar import astar_batch, astar_batch_np
 
 __all__ = [
     "DeviceGraph", "dist_to_targets", "first_move_from_dist",
     "build_fm_columns", "table_search_batch", "extract_paths",
     "doubled_tables", "lookup_tables", "ShiftGraph",
-    "dist_to_targets_shift",
+    "dist_to_targets_shift", "astar_batch", "astar_batch_np",
 ]

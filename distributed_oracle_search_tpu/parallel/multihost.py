@@ -84,9 +84,24 @@ def initialize_from_conf(conf) -> bool:
     if not mh:
         return False
     pid = mh.get("process_id", os.environ.get("DOS_PROCESS_ID"))
+    cpus = mh.get("cpu_devices_per_process")  # CPU-backed pods / tests
     initialize(coordinator=mh.get("coordinator"),
                num_processes=mh.get("num_processes"),
-               process_id=None if pid is None else int(pid))
+               process_id=None if pid is None else int(pid),
+               cpu_devices_per_process=None if cpus is None else int(cpus))
+    return True
+
+
+def is_primary() -> bool:
+    """True on the process that should write shared artifacts (process 0),
+    and on any single-controller run. Only consults the JAX process index
+    when multi-host mode was actually initialized — a run that never
+    configured ``multihost`` is always primary (a stray ``$DOS_PROCESS_ID``
+    in the shell must not silently suppress campaign output)."""
+    if getattr(initialize, "_done", False):
+        import jax
+
+        return jax.process_index() == 0
     return True
 
 

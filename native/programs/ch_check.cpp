@@ -1,0 +1,72 @@
+// ch_check — contraction-hierarchy self-verification harness.
+//
+// The FIFO stats wire carries only aggregate counters (reference
+// process_query.py:198-213), so CH cost correctness is proven here
+// instead: build the hierarchy for an .xy graph, then for every query in a
+// .scen file compare CH's cost against plain Dijkstra (A* with hscale=0 —
+// a zero heuristic IS Dijkstra) on the same weights. Exits non-zero on the
+// first mismatch; prints one summary line on success. Driven by
+// tests/test_native.py.
+//
+//   ch_check <graph.xy> <queries.scen> [witness_budget]
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../src/ch.hpp"
+#include "../src/graph.hpp"
+#include "../src/search.hpp"
+
+using namespace dos;
+
+static double now_monotonic() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+static int real_main(int argc, char** argv) {
+    if (argc < 3) die("usage: ch_check <graph.xy> <queries.scen> [budget]");
+    Graph g = load_xy(argv[1]);
+    auto queries = load_scen(argv[2]);
+    int64_t budget = argc > 3 ? std::atoll(argv[3]) : 64;
+
+    double t0 = now_monotonic();
+    CH ch;
+    ch.build(g, g.w, budget);
+    double t_build = now_monotonic() - t0;
+
+    SearchStats ch_stats, dij_stats;
+    CHSearch search(ch);
+    int64_t checked = 0;
+    t0 = now_monotonic();
+    for (auto& [s, t] : queries) {
+        QueryResult r = search.query(s, t, ch_stats);
+        QueryResult golden = astar(g, s, t, g.w, /*hscale=*/0.0,
+                                   /*fscale=*/0.0, dij_stats, /*cpu=*/0.0);
+        if (r.finished != golden.finished || r.cost != golden.cost) {
+            std::fprintf(stderr,
+                         "MISMATCH s=%ld t=%ld ch=(%ld fin=%d) "
+                         "dijkstra=(%ld fin=%d)\n",
+                         s, t, r.cost, int(r.finished), golden.cost,
+                         int(golden.finished));
+            return 1;
+        }
+        ++checked;
+    }
+    double t_query = now_monotonic() - t0;
+    std::printf("CH_OK n=%ld m=%ld shortcuts=%ld queries=%ld "
+                "build_s=%.3f ch_expanded=%ld dijkstra_expanded=%ld "
+                "query_s=%.3f\n",
+                g.n, g.m, ch.n_shortcuts, checked, t_build,
+                ch_stats.n_expanded, dij_stats.n_expanded, t_query);
+    return 0;
+}
+
+int main(int argc, char** argv) {
+    return run_main([&] { return real_main(argc, argv); });
+}

@@ -10,11 +10,16 @@
 //
 //   fifo_auto --input <xy> [<diff>] --partmethod M --partkey K...
 //             --workerid W --maxworker N --outdir <idxdir>
-//             --alg table-search|astar [--compress] [--fifo <path>]
+//             --alg table-search|astar|ch [--compress] [--fifo <path>]
 //
 // --alg astar serves the hscale/fscale weighted-A* family (the knobs the
 // reference exposes, args.py:30-57) straight off the graph — no CPD
 // needed — emitting the full priority-queue telemetry.
+//
+// --alg ch serves contraction-hierarchy queries (the congestion-free
+// family of the reference's TODO, reference README.md:133): the hierarchy
+// is built once at startup on FREE-FLOW weights; per-request diffs are
+// ignored with a warning (a diff would invalidate the shortcuts).
 //
 // Speaks the same wire as the Python worker/server.py, including the
 // __DOS_STOP__ shutdown token and the FAIL failure sentinel, so the head
@@ -29,6 +34,7 @@
 #include <cstring>
 #include <fstream>
 #include <map>
+#include <memory>
 #include <sstream>
 #include <string>
 #include <sys/stat.h>
@@ -36,6 +42,7 @@
 #include <unistd.h>
 #include <vector>
 
+#include "../src/ch.hpp"
 #include "../src/cpd.hpp"
 #include "../src/distribution_controller.hpp"
 #include "../src/graph.hpp"
@@ -143,7 +150,8 @@ struct Server {
     CpdShard shard;
     int64_t wid;
     std::string fifo_path;
-    std::string alg;  // table-search | astar
+    std::string alg;  // table-search | astar | ch
+    CH ch_idx;        // built at startup when alg == "ch" (free flow)
     std::map<std::string, std::vector<int32_t>> weight_cache;
 
     Server(Graph gg, DistributionController dcc, CpdShard sh, int64_t w,
@@ -200,6 +208,12 @@ struct Server {
         double t1 = now_s();
 
         bool use_astar = alg == "astar";
+        bool use_ch = alg == "ch";
+        if (use_ch && difffile != "-")
+            std::fprintf(stderr,
+                         "fifo_auto: --alg ch is congestion-free; ignoring "
+                         "diff %s (answers are free-flow)\n",
+                         difffile.c_str());
         double cpu = use_astar ? min_cost_per_unit(g, wq) : 0.0;
         SearchStats total;
         if (threads > 0) omp_set_num_threads(threads);
@@ -209,11 +223,19 @@ struct Server {
 #pragma omp parallel
             {
                 SearchStats local;
+                // per-thread CH search context: stamped arrays allocated
+                // once per batch, each query then costs O(settled)
+                std::unique_ptr<CHSearch> chs;
+                if (use_ch) chs = std::make_unique<CHSearch>(ch_idx);
 #pragma omp for schedule(dynamic, 64)
                 for (size_t q = 0; q < queries.size(); ++q) {
                     auto [s, t] = queries[q];
                     if (use_astar) {
                         astar(g, s, t, wq, hscale, fscale, local, cpu);
+                        continue;
+                    }
+                    if (use_ch) {
+                        chs->query(s, t, local);
                         continue;
                     }
                     int64_t row = dc.owned_idx[t];
@@ -275,10 +297,21 @@ struct Server {
         std::fprintf(stderr, "fifo_auto: worker %ld serving on %s\n", wid,
                      fifo_path.c_str());
         while (true) {
-            std::ifstream f(fifo_path);  // blocking-open rendezvous
-            std::stringstream ss;
-            ss << f.rdbuf();
-            std::string text = ss.str();
+            std::string text;
+            {
+                // blocking-open rendezvous — and the read end MUST close
+                // before handling/replying: a writer that opens while we
+                // are busy would otherwise buffer into THIS fd and be
+                // discarded by its destructor (a __DOS_STOP__ sent right
+                // after a reply was being lost to exactly that race; with
+                // the fd closed, the writer's open() blocks until the
+                // next loop iteration's fresh reader, so nothing is ever
+                // dropped).
+                std::ifstream f(fifo_path);
+                std::stringstream ss;
+                ss << f.rdbuf();
+                text = ss.str();
+            }
             if (text.find("__DOS_STOP__") != std::string::npos) {
                 ::unlink(fifo_path.c_str());
                 std::exit(0);
@@ -368,9 +401,10 @@ static int real_main(int argc, char** argv) {
     if (input.empty() || partmethod.empty() || workerid < 0 || maxworker <= 0)
         die("usage: fifo_auto --input XY [DIFF] --partmethod M --partkey K "
             "--workerid W --maxworker N --outdir D --alg table-search");
-    if (alg != "table-search" && alg != "astar")
-        die("--alg must be table-search (reference make_fifos.py:20) or "
-            "astar (this framework's hscale/fscale family)");
+    if (alg != "table-search" && alg != "astar" && alg != "ch")
+        die("--alg must be table-search (reference make_fifos.py:20), "
+            "astar (the hscale/fscale family), or ch (congestion-free "
+            "contraction hierarchies)");
     if (partkey.empty()) partkey.push_back(1);
     if (fifo.empty())
         fifo = "/tmp/worker" + std::to_string(workerid) + ".fifo";
@@ -378,13 +412,20 @@ static int real_main(int argc, char** argv) {
     Graph g = load_xy(input);
     DistributionController dc(partmethod, partkey, maxworker, g.n,
                               block_size);
-    // astar needs no first-move table; table-search loads its CPD shard
+    // astar/ch need no first-move table; table-search loads its CPD shard
     CpdShard shard;
     if (alg == "table-search")
         shard = CpdShard::load(outdir, workerid, dc.n_owned(workerid),
                                block_size, compress);
     Server server(std::move(g), std::move(dc), std::move(shard), workerid,
                   fifo, alg);
+    if (alg == "ch") {
+        double tb = now_s();
+        server.ch_idx.build(server.g, server.g.w);
+        std::fprintf(stderr,
+                     "fifo_auto: CH built in %.2fs (%ld shortcuts)\n",
+                     now_s() - tb, server.ch_idx.n_shortcuts);
+    }
     // preload the first diff like the reference server (make_fifos.py:18)
     server.weights_for(diff, false);
     server.serve();

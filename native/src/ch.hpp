@@ -1,0 +1,321 @@
+// Contraction hierarchies: the congestion-free query family the reference
+// lists as a TODO it never built (reference README.md:133 "congestion-free
+// algorithms: CH, CPD extractions"; SURVEY.md §2.2 C5).
+//
+// Classic directed CH:
+//  * preprocessing contracts nodes in importance order (lazy heap over
+//    edge-difference + deleted-neighbour + level), inserting a shortcut
+//    u->x for every in/out pair the contracted node v uniquely serves —
+//    "uniquely" established by a budget-limited witness Dijkstra; a failed
+//    (budget-exhausted) witness search conservatively inserts the shortcut,
+//    which can never make queries wrong, only the hierarchy denser;
+//  * a query is a bidirectional Dijkstra where both sides only climb the
+//    hierarchy (forward over up-edges from s, backward over reverse
+//    up-edges from t), meeting at the lowest-cost peak.
+//
+// Every CH edge carries the number of ORIGINAL edges it stands for
+// (shortcut hops = sum of its two parents), so plen comes out of the query
+// without unpacking shortcuts. Telemetry uses the same SearchStats
+// vocabulary as the A* family (reference process_query.py:198-213).
+//
+// CH answers on FREE-FLOW weights only: the hierarchy is built for one
+// weight function, and a congestion diff would invalidate both the witness
+// proofs and the shortcut weights — exactly why the reference files CH
+// under "congestion-free".
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <queue>
+#include <vector>
+
+#include "common.hpp"
+#include "graph.hpp"
+#include "search.hpp"
+
+namespace dos {
+
+struct CH {
+    // one record per CH edge (original or shortcut)
+    struct Edge {
+        int32_t to;
+        int32_t w;
+        int32_t hops;  // original edges represented
+    };
+
+    int64_t n = 0;
+    int64_t n_shortcuts = 0;
+    std::vector<int32_t> rank;            // contraction order, 0 = first
+    // upward search graphs (CSR): fwd = edges u->x with rank[x] > rank[u];
+    // bwd at x = reverse edges u->x with rank[u] > rank[x]
+    std::vector<int64_t> fwd_ptr, bwd_ptr;
+    std::vector<Edge> fwd, bwd;
+
+    void build(const Graph& g, const std::vector<int32_t>& w,
+               int64_t witness_budget = 64);
+};
+
+// Per-thread query context over a built CH. The O(n) arrays are allocated
+// once and reset by timestamp, and the meet scan walks only the forward
+// search's touched list — each query costs O(settled log settled), not
+// O(n) (the hierarchy's whole point). One instance per OMP thread; the CH
+// itself stays shared and immutable.
+struct CHSearch {
+    const CH* ch;
+    std::vector<int64_t> df, db;
+    std::vector<int32_t> hf, hb;
+    std::vector<int32_t> sf, sb;  // stamps: entry valid iff == cur
+    std::vector<int64_t> touched_f;
+    int32_t cur = 0;
+
+    explicit CHSearch(const CH& c)
+        : ch(&c), df(c.n), db(c.n), hf(c.n), hb(c.n), sf(c.n, -1),
+          sb(c.n, -1) {}
+
+    QueryResult query(int64_t s, int64_t t, SearchStats& stats) {
+        ++cur;
+        touched_f.clear();
+        using QE = std::pair<int64_t, int64_t>;
+        auto climb = [&](int64_t src, const std::vector<int64_t>& ptr,
+                         const std::vector<CH::Edge>& edges,
+                         std::vector<int64_t>& dist,
+                         std::vector<int32_t>& hops,
+                         std::vector<int32_t>& stamp, bool record) {
+            std::priority_queue<QE, std::vector<QE>, std::greater<QE>> pq;
+            stamp[src] = cur;
+            dist[src] = 0;
+            hops[src] = 0;
+            if (record) touched_f.push_back(src);
+            pq.emplace(0, src);
+            stats.n_inserted++;
+            while (!pq.empty()) {
+                auto [d, u] = pq.top();
+                pq.pop();
+                if (d > dist[u]) { stats.n_surplus++; continue; }
+                stats.n_expanded++;
+                for (int64_t p = ptr[u]; p < ptr[u + 1]; ++p) {
+                    const CH::Edge& e = edges[p];
+                    stats.n_touched++;
+                    int64_t nd = d + e.w;
+                    bool seen = stamp[e.to] == cur;
+                    if (!seen || nd < dist[e.to]) {
+                        if (seen) {
+                            stats.n_updated++;
+                        } else {
+                            stamp[e.to] = cur;
+                            if (record) touched_f.push_back(e.to);
+                        }
+                        dist[e.to] = nd;
+                        hops[e.to] = hops[u] + e.hops;
+                        pq.emplace(nd, e.to);
+                        stats.n_inserted++;
+                    }
+                }
+            }
+        };
+        climb(s, ch->fwd_ptr, ch->fwd, df, hf, sf, true);
+        climb(t, ch->bwd_ptr, ch->bwd, db, hb, sb, false);
+
+        QueryResult r;
+        int64_t best = INF, best_hops = 0;
+        for (int64_t v : touched_f)
+            if (sb[v] == cur && df[v] + db[v] < best) {
+                best = df[v] + db[v];
+                best_hops = hf[v] + hb[v];
+            }
+        r.finished = best < INF;
+        r.cost = r.finished ? best : 0;
+        r.plen = r.finished ? best_hops : 0;
+        stats.plen += r.plen;
+        stats.finished += r.finished ? 1 : 0;
+        return r;
+    }
+};
+
+namespace ch_detail {
+
+// dynamic adjacency used only during contraction: per active node, the
+// current out/in edges among still-active nodes (originals + shortcuts)
+struct DynEdge {
+    int32_t other;
+    int32_t w;
+    int32_t hops;
+};
+
+// limited Dijkstra from src among active nodes, excluding `skip`; stops
+// when `target_bound` settled or expansions exceed budget. Returns
+// dist[x] for x in `targets` (INF when not settled cheaply).
+struct WitnessSearch {
+    std::vector<int64_t> dist;
+    std::vector<int32_t> stamp;
+    int32_t cur = 0;
+
+    void init(int64_t n) {
+        dist.assign(n, INF);
+        stamp.assign(n, -1);
+    }
+
+    int64_t get(int64_t x) const { return stamp[x] == cur ? dist[x] : INF; }
+
+    void run(const std::vector<std::vector<DynEdge>>& out,
+             const std::vector<char>& active, int64_t src, int64_t skip,
+             int64_t cost_cap, int64_t budget) {
+        ++cur;
+        using QE = std::pair<int64_t, int64_t>;
+        std::priority_queue<QE, std::vector<QE>, std::greater<QE>> pq;
+        stamp[src] = cur;
+        dist[src] = 0;
+        pq.emplace(0, src);
+        int64_t expansions = 0;
+        while (!pq.empty() && expansions < budget) {
+            auto [d, u] = pq.top();
+            pq.pop();
+            if (d > get(u)) continue;
+            if (d > cost_cap) break;  // nothing cheaper left to prove
+            ++expansions;
+            for (const DynEdge& e : out[u]) {
+                int64_t v = e.other;
+                if (v == skip || !active[v]) continue;
+                int64_t nd = d + e.w;
+                if (nd < get(v)) {
+                    stamp[v] = cur;
+                    dist[v] = nd;
+                    pq.emplace(nd, v);
+                }
+            }
+        }
+    }
+};
+
+inline void add_or_relax(std::vector<DynEdge>& edges, int32_t other,
+                         int32_t w, int32_t hops) {
+    for (DynEdge& e : edges)
+        if (e.other == other) {
+            if (w < e.w) { e.w = w; e.hops = hops; }
+            return;
+        }
+    edges.push_back({other, w, hops});
+}
+
+}  // namespace ch_detail
+
+inline void CH::build(const Graph& g, const std::vector<int32_t>& w,
+                      int64_t witness_budget) {
+    using namespace ch_detail;
+    n = g.n;
+    n_shortcuts = 0;
+    std::vector<std::vector<DynEdge>> out(n), in(n);
+    for (int64_t e = 0; e < g.m; ++e) {
+        if (g.src[e] == g.dst[e]) continue;  // self-loops never help
+        add_or_relax(out[g.src[e]], int32_t(g.dst[e]), w[e], 1);
+        add_or_relax(in[g.dst[e]], int32_t(g.src[e]), w[e], 1);
+    }
+    // permanent record of every CH edge (originals deduped to min weight
+    // + shortcuts as they are created)
+    std::vector<std::vector<DynEdge>> all_out(n);
+    for (int64_t u = 0; u < n; ++u) all_out[u] = out[u];
+
+    std::vector<char> active(n, 1);
+    std::vector<int32_t> deleted_nbrs(n, 0);
+    std::vector<int32_t> level(n, 0);
+    rank.assign(n, 0);
+    WitnessSearch ws;
+    ws.init(n);
+
+    // simulate contraction of v: count needed shortcuts (and optionally
+    // materialize them). Returns #shortcuts.
+    auto contract = [&](int64_t v, bool commit) -> int64_t {
+        int64_t added = 0;
+        for (const DynEdge& ein : in[v]) {
+            int64_t u = ein.other;
+            if (!active[u] || u == v) continue;
+            // one witness search from u covers every out-target of v
+            int64_t cap = 0;
+            for (const DynEdge& eout : out[v])
+                if (active[eout.other] && eout.other != v)
+                    cap = std::max(cap, int64_t(ein.w) + eout.w);
+            ws.run(out, active, u, v, cap, witness_budget);
+            for (const DynEdge& eout : out[v]) {
+                int64_t x = eout.other;
+                if (!active[x] || x == v || x == u) continue;
+                int64_t via = int64_t(ein.w) + eout.w;
+                if (ws.get(x) <= via) continue;  // witness proves v useless
+                ++added;
+                if (commit) {
+                    int32_t hops = ein.hops + eout.hops;
+                    add_or_relax(out[u], int32_t(x), int32_t(via), hops);
+                    add_or_relax(in[x], int32_t(u), int32_t(via), hops);
+                    add_or_relax(all_out[u], int32_t(x), int32_t(via), hops);
+                    ++n_shortcuts;
+                }
+            }
+        }
+        return added;
+    };
+
+    auto degree = [&](int64_t v) -> int64_t {
+        int64_t d = 0;
+        for (const DynEdge& e : out[v]) d += active[e.other] && e.other != v;
+        for (const DynEdge& e : in[v]) d += active[e.other] && e.other != v;
+        return d;
+    };
+    auto priority = [&](int64_t v) -> int64_t {
+        return contract(v, false) - degree(v) + 2 * deleted_nbrs[v]
+               + level[v];
+    };
+
+    using QE = std::pair<int64_t, int64_t>;  // (priority, node)
+    std::priority_queue<QE, std::vector<QE>, std::greater<QE>> pq;
+    for (int64_t v = 0; v < n; ++v) pq.emplace(priority(v), v);
+
+    int32_t next_rank = 0;
+    while (!pq.empty()) {
+        auto [p, v] = pq.top();
+        pq.pop();
+        if (!active[v]) continue;
+        int64_t pnow = priority(v);  // lazy: re-check against current graph
+        if (!pq.empty() && pnow > pq.top().first) {
+            pq.emplace(pnow, v);
+            continue;
+        }
+        contract(v, true);
+        active[v] = 0;
+        rank[v] = next_rank++;
+        for (const DynEdge& e : out[v])
+            if (active[e.other]) {
+                deleted_nbrs[e.other]++;
+                level[e.other] = std::max(level[e.other], level[v] + 1);
+            }
+        for (const DynEdge& e : in[v])
+            if (active[e.other]) {
+                deleted_nbrs[e.other]++;
+                level[e.other] = std::max(level[e.other], level[v] + 1);
+            }
+    }
+
+    // freeze the upward CSRs from the full edge record
+    fwd_ptr.assign(n + 1, 0);
+    bwd_ptr.assign(n + 1, 0);
+    for (int64_t u = 0; u < n; ++u)
+        for (const DynEdge& e : all_out[u]) {
+            if (rank[e.other] > rank[u]) fwd_ptr[u + 1]++;
+            else bwd_ptr[e.other + 1]++;
+        }
+    for (int64_t i = 0; i < n; ++i) {
+        fwd_ptr[i + 1] += fwd_ptr[i];
+        bwd_ptr[i + 1] += bwd_ptr[i];
+    }
+    fwd.resize(fwd_ptr[n]);
+    bwd.resize(bwd_ptr[n]);
+    std::vector<int64_t> fc(fwd_ptr.begin(), fwd_ptr.end() - 1);
+    std::vector<int64_t> bc(bwd_ptr.begin(), bwd_ptr.end() - 1);
+    for (int64_t u = 0; u < n; ++u)
+        for (const DynEdge& e : all_out[u]) {
+            if (rank[e.other] > rank[u])
+                fwd[fc[u]++] = {e.other, e.w, e.hops};
+            else
+                bwd[bc[e.other]++] = {int32_t(u), e.w, e.hops};
+        }
+}
+
+}  // namespace dos

@@ -1,0 +1,32 @@
+"""Subprocess body for the 2-process conf-driven campaign test (not a
+pytest file).
+
+Unlike ``multihost_worker.py`` (which drives the builder directly), this
+exercises the actual driver: ``cli.process_query.main`` with a cluster conf
+whose ``multihost`` key wires the processes into one mesh — proving the
+drivers themselves, not just the kernels, run multi-controller (SURVEY.md
+§7 stage 6).
+
+Usage: multihost_campaign_worker.py <process_id> <conf_path> <out_dir>
+"""
+
+import os
+import sys
+
+pid, conf_path, out_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+
+# the driver resolves the process id from $DOS_PROCESS_ID
+# (parallel/multihost.py initialize_from_conf)
+os.environ["DOS_PROCESS_ID"] = str(pid)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_oracle_search_tpu.cli import process_query  # noqa: E402
+
+rc = process_query.main(["-c", conf_path, "-o", out_dir])
+assert rc == 0, rc
+
+import jax  # noqa: E402
+
+print(f"CAMPAIGN_OK process={pid} nproc={jax.process_count()} "
+      f"devices={len(jax.devices())}")

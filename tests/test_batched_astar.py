@@ -1,0 +1,133 @@
+"""Batched device A* (``ops.batched_astar``): optimality, pruning,
+weighted bound, engine serving path, deadline truncation.
+
+The serving-path counterpart of the per-query CPU heap oracle
+(``models.astar``) — same knobs (reference ``args.py:30-57``), lock-step
+dense sweeps instead of a priority queue.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.cli import process_query as pq
+from distributed_oracle_search_tpu.cli.args import parse_args
+from distributed_oracle_search_tpu.data import (
+    Graph, ensure_synth_dataset, read_scen, synth_city_graph, synth_scenario,
+)
+from distributed_oracle_search_tpu.models.reference import dist_to_target
+from distributed_oracle_search_tpu.ops import astar_batch_np
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return synth_city_graph(9, 7, seed=41)
+
+
+@pytest.fixture(scope="module")
+def queries(graph):
+    return synth_scenario(graph.n, 48, seed=5)
+
+
+@pytest.fixture(scope="module")
+def opt(graph, queries):
+    """Golden optimal costs via the CPU Dijkstra oracle, per target."""
+    cost = np.zeros(len(queries), np.int64)
+    for i, (s, t) in enumerate(queries):
+        cost[i] = dist_to_target(graph, int(t))[int(s)]
+    return cost
+
+
+def test_admissible_is_exactly_optimal(graph, queries, opt):
+    cost, plen, fin, counters = astar_batch_np(graph, queries, hscale=1.0)
+    assert fin.all()
+    np.testing.assert_array_equal(cost, opt)
+    assert (plen >= (opt > 0)).all() and (plen < graph.n).all()
+    assert counters["n_expanded"] > 0 and counters["n_inserted"] > 0
+    assert counters["n_touched"] >= counters["n_expanded"]
+
+
+def test_chunking_is_transparent(graph, queries, opt):
+    cost, _, fin, _ = astar_batch_np(graph, queries, hscale=1.0, chunk=7)
+    assert fin.all()
+    np.testing.assert_array_equal(cost, opt)
+
+
+def test_diffed_weights_optimal(graph, queries):
+    rng = np.random.default_rng(3)
+    w = graph.w.copy()
+    bump = rng.integers(0, 2, graph.m).astype(bool)
+    w[bump] = w[bump] * 3
+    cost, _, fin, _ = astar_batch_np(graph, queries, w=w, hscale=1.0)
+    assert fin.all()
+    for i, (s, t) in enumerate(queries):
+        assert cost[i] == dist_to_target(graph, int(t), w=w)[int(s)]
+
+
+def test_weighted_bound_and_pruning(graph, queries, opt):
+    """hscale > 1: costs bounded by hscale x optimal (weighted-A* bound),
+    and the aggressive prune does strictly less edge work."""
+    c1, _, f1, k1 = astar_batch_np(graph, queries, hscale=1.0)
+    c3, _, f3, k3 = astar_batch_np(graph, queries, hscale=3.0)
+    assert f3.all()
+    assert (c3 >= opt).all()
+    assert (c3 <= 3.0 * opt + 1e-9).all()
+    assert k3["n_touched"] < k1["n_touched"]
+
+
+def test_fscale_keeps_optimality(graph, queries, opt):
+    """fscale loosens the incumbent prune — admissible search stays
+    optimal (CPU-oracle parity: models/astar.py fscale semantics)."""
+    cost, _, fin, _ = astar_batch_np(graph, queries, hscale=1.0, fscale=0.5)
+    assert fin.all()
+    np.testing.assert_array_equal(cost, opt)
+
+
+def test_past_deadline_returns_all_unfinished(graph, queries):
+    cost, plen, fin, counters = astar_batch_np(
+        graph, queries, deadline=time.perf_counter() - 1.0)
+    assert not fin.any()
+    assert (cost == 0).all() and (plen == 0).all()
+    assert counters["n_expanded"] == 0
+
+
+def test_engine_astar_deadline_truncates_batch(tmp_path):
+    """A 1 ns budget cuts the campaign short with finished < size and
+    correct partial stats (reference args.py:38-57 time-budget teeth)."""
+    from distributed_oracle_search_tpu.parallel.partition import (
+        DistributionController,
+    )
+    from distributed_oracle_search_tpu.worker import ShardEngine
+
+    dataset = ensure_synth_dataset(str(tmp_path), width=9, height=7,
+                                   n_queries=48, seed=41)
+    graph = Graph.from_xy(dataset["xy"])
+    dc = DistributionController("mod", 1, 1, graph.n)
+    eng = ShardEngine(graph, dc, wid=0, outdir=str(tmp_path), alg="astar")
+    qs = read_scen(dataset["scen"])[:16]
+    args = parse_args(["--ns-lim", "1"])
+    cfg = pq.runtime_config(args)
+    assert cfg.time == 1
+    cost, plen, fin, stats = eng.answer(qs, cfg)
+    assert stats.finished == int(fin.sum()) < len(qs)
+
+
+def test_engine_debug_uses_heap_oracle(tmp_path):
+    """config.debug routes to the per-query CPU heap oracle; costs agree
+    with the batched kernel (both optimal at hscale=1)."""
+    from distributed_oracle_search_tpu.parallel.partition import (
+        DistributionController,
+    )
+    from distributed_oracle_search_tpu.worker import ShardEngine
+
+    dataset = ensure_synth_dataset(str(tmp_path), width=9, height=7,
+                                   n_queries=48, seed=41)
+    graph = Graph.from_xy(dataset["xy"])
+    dc = DistributionController("mod", 1, 1, graph.n)
+    eng = ShardEngine(graph, dc, wid=0, outdir=str(tmp_path), alg="astar")
+    qs = read_scen(dataset["scen"])[:12]
+    fast = eng.answer(qs, pq.runtime_config(parse_args([])))
+    dbg = eng.answer(qs, pq.runtime_config(parse_args(["--debug"])))
+    np.testing.assert_array_equal(fast[0], dbg[0])
+    assert dbg[3].finished == fast[3].finished == len(qs)

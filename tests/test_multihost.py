@@ -48,6 +48,85 @@ def test_two_process_mesh_build():
         assert f"MULTIHOST_OK process={pid} devices=8" in out, out[-2000:]
 
 
+def test_two_process_conf_driven_campaign(tmp_path):
+    """The DRIVERS run multi-controller: two processes execute
+    ``cli.process_query`` against one cluster conf whose ``multihost`` key
+    joins them into a single 8-device mesh; process 0 alone writes the
+    artifact trio (VERDICT r1 next-#10)."""
+    import csv
+    import json
+
+    import numpy as np
+
+    from distributed_oracle_search_tpu.data import (
+        Graph, ensure_synth_dataset, read_scen,
+    )
+    from distributed_oracle_search_tpu.models.cpd import CPDOracle
+    from distributed_oracle_search_tpu.parallel import DistributionController
+    from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+
+    datadir = str(tmp_path / "data")
+    index = str(tmp_path / "index")
+    out = str(tmp_path / "out")
+    dataset = ensure_synth_dataset(datadir, width=10, height=8,
+                                   n_queries=96, seed=13)
+    n_queries = len(read_scen(dataset["scen"]))
+
+    # prebuild the index in THIS process (8 virtual devices via conftest);
+    # the two campaign controllers then oracle.load() it
+    g = Graph.from_xy(dataset["xy"])
+    dc = DistributionController("tpu", 8, 8, g.n)
+    oracle = CPDOracle(g, dc, mesh=make_mesh(n_workers=8))
+    oracle.build()
+    oracle.save(index)
+
+    coord = f"127.0.0.1:{_free_port()}"
+    conf_path = str(tmp_path / "conf.json")
+    with open(conf_path, "w") as f:
+        json.dump({
+            "workers": [f"tpu:{i}" for i in range(8)],
+            "partmethod": "tpu", "partkey": 8,
+            "outdir": index, "xy_file": dataset["xy"],
+            "scenfile": dataset["scen"],
+            "diffs": ["-", dataset["diff"]],
+            "multihost": {"coordinator": coord, "num_processes": 2,
+                          "cpu_devices_per_process": 4},
+        }, f)
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "multihost_campaign_worker.py"),
+         str(pid), conf_path, out],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            o, _ = p.communicate(timeout=240)
+            outs.append(o)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{o[-2000:]}"
+        assert f"CAMPAIGN_OK process={pid} nproc=2 devices=8" in o, o[-2000:]
+
+    # only process 0 wrote the artifacts; rows account for every query
+    with open(os.path.join(out, "metrics.json")) as f:
+        assert json.load(f)["num_queries"] == n_queries
+    with open(os.path.join(out, "parts.csv")) as f:
+        rows = list(csv.reader(f))[1:]
+    by_round = {}
+    for row in rows:
+        by_round.setdefault(row[0], []).append(row)
+    assert len(by_round) == 2                       # one per diff
+    for rnd in by_round.values():
+        finished = sum(int(float(r[7])) for r in rnd)
+        assert finished == n_queries
+
+
 def test_initialize_from_conf_noop_without_key():
     from distributed_oracle_search_tpu.parallel.multihost import (
         initialize_from_conf,

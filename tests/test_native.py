@@ -39,7 +39,7 @@ def bins():
     bindir = os.path.join(REPO, "native", "build", "fast", "bin")
     return {name: os.path.join(bindir, name)
             for name in ("make_cpd_auto", "gen_distribute_conf",
-                         "fifo_auto")}
+                         "fifo_auto", "ch_check")}
 
 
 @pytest.fixture(scope="module")
@@ -323,6 +323,63 @@ def test_fifo_auto_astar(bins, dataset, tmp_path):
         # optimal path lengths: plen sum must equal the oracle's hop counts
         # is not guaranteed (ties), but costs are checked via plen>0 and
         # the finished count; cost itself is not on the stats wire.
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def test_ch_golden_vs_dijkstra(bins, dataset):
+    """Contraction hierarchies (the reference's congestion-free TODO,
+    reference README.md:133): every scen query's CH cost is bit-equal to
+    Dijkstra's, and the hierarchy does strictly less expansion work —
+    verified by the native self-check harness (ch_check.cpp)."""
+    datadir, paths = dataset
+    r = subprocess.run([bins["ch_check"], paths["xy"], paths["scen"]],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert r.stdout.startswith("CH_OK"), r.stdout
+    fields = dict(kv.split("=") for kv in r.stdout.split()[1:])
+    assert int(fields["queries"]) == 96
+    assert int(fields["ch_expanded"]) < int(fields["dijkstra_expanded"])
+
+
+def test_fifo_auto_ch(bins, dataset, tmp_path):
+    """--alg ch serves over the same FIFO wire; a congestion diff in the
+    request is ignored with a warning (free-flow answers)."""
+    from distributed_oracle_search_tpu.data import read_scen
+    from distributed_oracle_search_tpu.transport.fifo import send
+    from distributed_oracle_search_tpu.transport.wire import (
+        Request, RuntimeConfig, write_query_file,
+    )
+
+    datadir, paths = dataset
+    fifo = str(tmp_path / "ch.fifo")
+    proc = subprocess.Popen(
+        [bins["fifo_auto"], "--input", paths["xy"], "--partmethod", "mod",
+         "--partkey", "1", "--workerid", "0", "--maxworker", "1",
+         "--outdir", str(tmp_path), "--alg", "ch", "--fifo", fifo],
+        stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 30
+        while not os.path.exists(fifo):
+            assert time.time() < deadline
+            time.sleep(0.05)
+        queries = read_scen(paths["scen"])[:24]
+        qfile = str(tmp_path / "qch")
+        write_query_file(qfile, queries)
+        row = send("localhost", Request(RuntimeConfig(), qfile,
+                                        str(tmp_path / "ach.fifo")),
+                   fifo, timeout=60)
+        assert row.ok and row.finished == len(queries)
+        assert row.n_expanded > 0 and row.plen > 0
+        # diffed request: still answered (free-flow), not FAIL
+        row2 = send("localhost", Request(RuntimeConfig(), qfile,
+                                         str(tmp_path / "ach2.fifo"),
+                                         paths["diff"]),
+                    fifo, timeout=60)
+        assert row2.ok and row2.finished == len(queries)
+        assert row2.plen == row.plen
     finally:
         with open(fifo, "w") as fh:
             fh.write("__DOS_STOP__\n")
