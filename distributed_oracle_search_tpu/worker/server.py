@@ -73,18 +73,62 @@ class FifoServer:
         return stats
 
     def serve_forever(self) -> None:
+        """Framed request loop over a PERSISTENT command-FIFO read session.
+
+        The reference documents a FIFO race (reference README.md:125-127)
+        that a naive open-to-EOF session per request re-inherits: if
+        writer B opens the FIFO before the server sees writer A's EOF,
+        B's request lands in the dying session and is silently dropped —
+        B then blocks forever on its answer FIFO. So instead the server
+        opens the FIFO once with ``O_RDWR`` (its own write end guarantees
+        ``readline`` never sees EOF, only blocks) and parses requests
+        frame-by-frame: exactly two newline-terminated lines each.
+        Back-to-back writers simply queue in the pipe buffer — a request
+        under ``PIPE_BUF`` (4 KiB on Linux, far above any real request)
+        is written atomically, so frames can never interleave.
+        """
         self._ensure_fifo()
         log.info("worker %d serving on %s", self.wid, self.command_fifo)
+        fd = os.open(self.command_fifo, os.O_RDWR)
+        self._rdbuf = b""
         try:
             while True:
-                # blocking open = rendezvous with the head's writer
-                with open(self.command_fifo) as f:
-                    text = f.read()
-                if STOP_TOKEN in text:
+                line1 = self._next_line(fd)
+                if STOP_TOKEN in line1:
                     log.info("worker %d: stop requested", self.wid)
                     return
-                if not text.strip():
+                if not line1.strip():
                     continue
+                if not line1.lstrip().startswith("{"):
+                    # frame starts are self-identifying: a config line is
+                    # always a JSON object, a paths line never is. A stray
+                    # non-JSON line is garbage — handle it standalone so
+                    # it can NEVER pair with (and eat) the next writer's
+                    # config line; best-effort FAIL any FIFO it names
+                    log.error("stray non-frame line: %r", line1)
+                    self._answer_malformed(line1)
+                    continue
+                # a legit writer ships both lines in ONE atomic write, so
+                # line 2 is already in the pipe; bound the wait so a
+                # config-only garbage frame cannot desync the stream
+                line2 = self._next_line(fd, timeout=self.FRAME_TIMEOUT_S)
+                if line2 is None:
+                    log.error("half frame (no line 2 within %.1fs): %r",
+                              self.FRAME_TIMEOUT_S, line1)
+                    continue
+                if STOP_TOKEN in line2:
+                    # a stop chasing a truncated 1-line request must
+                    # still win: never strand the shutdown token
+                    log.info("worker %d: stop requested", self.wid)
+                    return
+                if line2.lstrip().startswith("{"):
+                    # a config line where the paths line belongs: the
+                    # previous writer truncated. Push it back to start the
+                    # next frame instead of corrupting two requests
+                    log.error("config-only half frame: %r", line1)
+                    self._rdbuf = line2.encode() + self._rdbuf
+                    continue
+                text = line1 + line2
                 try:
                     req = Request.decode(text)
                 except ValueError as e:
@@ -93,17 +137,55 @@ class FifoServer:
                     continue
                 try:
                     stats = self.handle(req)
-                except Exception as e:  # noqa: BLE001 — never leave the
-                    # head blocked on `cat answer`; send a failure row
+                except Exception as e:  # noqa: BLE001 — never leave
+                    # the head blocked on `cat answer`; send a failure
                     log.exception("batch failed: %s", e)
                     stats = StatsRow.failed()
                 self._reply(req.answerfifo, stats.encode_wire() + "\n")
         finally:
+            os.close(fd)
             if os.path.exists(self.command_fifo):
                 os.remove(self.command_fifo)
 
-    #: how long to wait for the head to open its answer-FIFO reader
-    REPLY_DEADLINE_S = 30.0
+    #: bound on the gap between a frame's two lines (one atomic writer
+    #: write puts both in the pipe together; only garbage arrives alone)
+    FRAME_TIMEOUT_S = 2.0
+
+    def _next_line(self, fd: int, timeout: float | None = None):
+        """Next newline-terminated line off the persistent FIFO fd (own
+        buffering — a buffered file object would hide pipe data from
+        ``select``). ``timeout`` bounds the wait (None = forever); returns
+        None on timeout."""
+        import select
+
+        while True:
+            nl = self._rdbuf.find(b"\n")
+            if nl >= 0:
+                line = self._rdbuf[:nl + 1]
+                self._rdbuf = self._rdbuf[nl + 1:]
+                return line.decode(errors="replace")
+            if timeout is not None:
+                ready, _, _ = select.select([fd], [], [], timeout)
+                if not ready:
+                    return None
+            chunk = os.read(fd, 4096)
+            if not chunk:       # cannot happen with our own O_RDWR write
+                import time as _time
+                _time.sleep(0.01)  # defensive: never spin
+            self._rdbuf += chunk
+
+    @property
+    def reply_deadline_s(self) -> float:
+        """How long to wait for the head to open its answer-FIFO reader.
+        Read lazily (not at import) so tests/monkeypatched env work; a
+        malformed value falls back to the default instead of crashing."""
+        try:
+            v = float(os.environ.get("DOS_REPLY_DEADLINE_S", "30"))
+        except ValueError:
+            return 30.0
+        # a zero/negative deadline would drop every reply whose reader
+        # has not already opened — same guard as the native server's
+        return v if v > 0 else 30.0
 
     def _reply(self, answerfifo: str, line: str) -> None:
         """Write the stats line without ever wedging the server: a
@@ -114,7 +196,8 @@ class FifoServer:
         import errno
         import time as _time
 
-        deadline = _time.monotonic() + self.REPLY_DEADLINE_S
+        wait_s = self.reply_deadline_s
+        deadline = _time.monotonic() + wait_s
         fd = -1
         while fd < 0:
             try:
@@ -125,7 +208,7 @@ class FifoServer:
                     return
                 if _time.monotonic() > deadline:
                     log.error("no reader on %s within %.0fs; dropping "
-                              "reply", answerfifo, self.REPLY_DEADLINE_S)
+                              "reply", answerfifo, wait_s)
                     return
                 _time.sleep(0.05)
         try:
@@ -138,18 +221,21 @@ class FifoServer:
             os.close(fd)
 
     def _answer_malformed(self, text: str) -> None:
-        """Best effort: recover the answer FIFO path from line 2 of a
-        malformed request and send the failure sentinel, so the head's
-        ``cat <answer>`` never blocks forever."""
-        lines = text.strip("\n").split("\n")
-        if len(lines) < 2:
-            return
-        tokens = lines[1].split()
-        if len(tokens) < 2:
-            return
-        answerfifo = tokens[1]
-        if os.path.exists(answerfifo):
-            self._reply(answerfifo, StatsRow.failed().encode_wire() + "\n")
+        """Best effort: find an answer-FIFO path among the tokens of a
+        malformed request (any line — a stray paths line carries it in
+        token 2, a full 2-line frame in line 2) and send the failure
+        sentinel, so the head's ``cat <answer>`` never blocks forever."""
+        import stat
+
+        for line in text.strip("\n").split("\n"):
+            for tok in line.split():
+                try:
+                    if stat.S_ISFIFO(os.stat(tok).st_mode):
+                        self._reply(tok,
+                                    StatsRow.failed().encode_wire() + "\n")
+                        return
+                except OSError:
+                    continue
 
     def stop_file(self) -> None:
         """Write the stop token into our own FIFO (for another process)."""

@@ -28,6 +28,7 @@
 
 #include <fcntl.h>
 #include <omp.h>
+#include <poll.h>
 
 #include <cctype>
 #include <cerrno>
@@ -290,36 +291,133 @@ struct Server {
         return buf;
     }
 
+    // line-buffered reader over the persistent command-FIFO fd
+    std::string fifo_pending;
+
+    // next newline-terminated line; timeout_ms < 0 waits forever.
+    // Returns false on timeout (line untouched).
+    bool next_line(int fd, std::string* line, int timeout_ms = -1) {
+        size_t nl;
+        while ((nl = fifo_pending.find('\n')) == std::string::npos) {
+            if (timeout_ms >= 0) {
+                struct pollfd p{fd, POLLIN, 0};
+                int r = ::poll(&p, 1, timeout_ms);
+                if (r == 0) return false;
+                if (r < 0 && errno != EINTR)
+                    die(std::string("poll ") + fifo_path + ": " +
+                        std::strerror(errno));
+                if (r < 0) continue;
+            }
+            char buf[4096];
+            ssize_t k = ::read(fd, buf, sizeof buf);
+            if (k < 0) {
+                if (errno == EINTR) continue;
+                die(std::string("read ") + fifo_path + ": " +
+                    std::strerror(errno));
+            }
+            if (k == 0) { ::usleep(10 * 1000); continue; }  // defensive
+            fifo_pending.append(buf, size_t(k));
+        }
+        *line = fifo_pending.substr(0, nl);
+        fifo_pending.erase(0, nl + 1);
+        return true;
+    }
+
+    // best effort: find an answer-FIFO path among a garbage line's
+    // tokens and send the FAIL sentinel so a stranded head never blocks
+    void answer_malformed(const std::string& line) {
+        std::istringstream ss(line);
+        std::string tok;
+        while (ss >> tok) {
+            struct stat st;
+            if (::stat(tok.c_str(), &st) == 0 && S_ISFIFO(st.st_mode)) {
+                int fd = ::open(tok.c_str(), O_WRONLY | O_NONBLOCK);
+                if (fd < 0) {
+                    // give a just-arriving reader a moment, then drop
+                    for (int i = 0; i < 40 && fd < 0; ++i) {
+                        ::usleep(50 * 1000);
+                        fd = ::open(tok.c_str(), O_WRONLY | O_NONBLOCK);
+                    }
+                }
+                if (fd >= 0) {
+                    ::fcntl(fd, F_SETFL,
+                            ::fcntl(fd, F_GETFL) & ~O_NONBLOCK);
+                    const char* fail = "FAIL\n";
+                    ssize_t n = ::write(fd, fail, 5);
+                    (void)n;
+                    ::close(fd);
+                }
+                return;
+            }
+        }
+    }
+
     [[noreturn]] void serve() {
         ::unlink(fifo_path.c_str());
         if (::mkfifo(fifo_path.c_str(), 0666) != 0)
             die("mkfifo " + fifo_path + ": " + std::strerror(errno));
         std::fprintf(stderr, "fifo_auto: worker %ld serving on %s\n", wid,
                      fifo_path.c_str());
+        // PERSISTENT read session, O_RDWR: our own write end keeps the
+        // pipe alive, so read() never sees EOF and requests from
+        // back-to-back writers queue in the pipe buffer instead of
+        // coalescing into a dying open-to-EOF session (the reference's
+        // documented FIFO race, reference README.md:125-127 — a second
+        // writer's request used to be appended to the first writer's
+        // session and silently discarded, deadlocking that writer on its
+        // answer FIFO). Frames are newline-delimited, exactly 2 lines
+        // per request; writes <= PIPE_BUF (4 KiB) are atomic so frames
+        // cannot interleave even with concurrent writers.
+        int cfd = ::open(fifo_path.c_str(), O_RDWR);
+        if (cfd < 0)
+            die("open " + fifo_path + ": " + std::strerror(errno));
         while (true) {
-            std::string text;
-            {
-                // blocking-open rendezvous — and the read end MUST close
-                // before handling/replying: a writer that opens while we
-                // are busy would otherwise buffer into THIS fd and be
-                // discarded by its destructor (a __DOS_STOP__ sent right
-                // after a reply was being lost to exactly that race; with
-                // the fd closed, the writer's open() blocks until the
-                // next loop iteration's fresh reader, so nothing is ever
-                // dropped).
-                std::ifstream f(fifo_path);
-                std::stringstream ss;
-                ss << f.rdbuf();
-                text = ss.str();
-            }
-            if (text.find("__DOS_STOP__") != std::string::npos) {
+            std::string line1, line2;
+            next_line(cfd, &line1);
+            if (line1.find("__DOS_STOP__") != std::string::npos) {
                 ::unlink(fifo_path.c_str());
                 std::exit(0);
             }
-            auto nl = text.find('\n');
-            if (nl == std::string::npos) continue;
-            std::string cfg = text.substr(0, nl);
-            std::istringstream l2(text.substr(nl + 1));
+            size_t first = line1.find_first_not_of(" \t\r");
+            if (first == std::string::npos)
+                continue;
+            if (line1[first] != '{') {
+                // frame starts are self-identifying: a config line is
+                // always a JSON object, a paths line never is. A stray
+                // non-JSON line is garbage — handle it standalone so it
+                // can NEVER pair with (and eat) the next writer's config
+                // line; best-effort FAIL any FIFO it names
+                std::fprintf(stderr, "fifo_auto: stray non-frame line: "
+                             "%s\n", line1.c_str());
+                answer_malformed(line1);
+                continue;
+            }
+            // a legit writer ships both lines in ONE atomic write, so
+            // line 2 is already in the pipe; bound the wait so a
+            // config-only garbage frame cannot desync the stream
+            if (!next_line(cfd, &line2, 2000)) {
+                std::fprintf(stderr,
+                             "fifo_auto: half frame (no line 2): %s\n",
+                             line1.c_str());
+                continue;
+            }
+            if (line2.find("__DOS_STOP__") != std::string::npos) {
+                // a stop chasing a truncated request must still win
+                ::unlink(fifo_path.c_str());
+                std::exit(0);
+            }
+            size_t f2 = line2.find_first_not_of(" \t\r");
+            if (f2 != std::string::npos && line2[f2] == '{') {
+                // a config line where the paths line belongs: the
+                // previous writer truncated. Push it back to start the
+                // next frame instead of corrupting two requests
+                std::fprintf(stderr, "fifo_auto: config-only half frame: "
+                             "%s\n", line1.c_str());
+                fifo_pending = line2 + "\n" + fifo_pending;
+                continue;
+            }
+            std::string cfg = line1;
+            std::istringstream l2(line2);
             std::string queryfile, answerfifo, difffile;
             l2 >> queryfile >> answerfifo >> difffile;
             if (answerfifo.empty()) continue;
@@ -333,8 +431,18 @@ struct Server {
             // non-blocking open with a bounded deadline: if the head died
             // before opening its `cat <answer>` reader, a blocking open
             // would wedge this worker for every future request. Drop the
-            // reply (and log) if no reader appears in time.
-            double give_up = now_s() + 30.0;
+            // reply (and log) if no reader appears in time
+            // (DOS_REPLY_DEADLINE_S env overrides, for fast tests).
+            static const double reply_deadline_s = [] {
+                const char* e = std::getenv("DOS_REPLY_DEADLINE_S");
+                if (!e || !*e) return 30.0;
+                char* end = nullptr;
+                double v = std::strtod(e, &end);
+                // malformed value falls back instead of becoming a 0s
+                // deadline that drops every reply
+                return (end && *end == '\0' && v > 0) ? v : 30.0;
+            }();
+            double give_up = now_s() + reply_deadline_s;
             int fd = -1;
             while (fd < 0 && now_s() < give_up) {
                 fd = ::open(answerfifo.c_str(), O_WRONLY | O_NONBLOCK);
@@ -345,8 +453,9 @@ struct Server {
             }
             if (fd < 0) {
                 std::fprintf(stderr,
-                             "fifo_auto: no reader on %s within 30s; "
-                             "dropping reply\n", answerfifo.c_str());
+                             "fifo_auto: no reader on %s within %.0fs; "
+                             "dropping reply\n", answerfifo.c_str(),
+                             reply_deadline_s);
                 continue;
             }
             // reader present: clear O_NONBLOCK so the write itself blocks

@@ -438,3 +438,48 @@ def test_make_cpds_test_mode_bootstraps_dataset(tmp_path, monkeypatch):
     assert cpds_main(["-t"]) == 0
     assert os.path.exists("data/synth-city.xy")
     assert os.path.exists("data/index/index.json")
+
+
+def test_python_server_back_to_back_writers(host_conf, built_index,
+                                            tmp_path):
+    """N separate writers in quick succession must each get a reply (same
+    coalescing-race regression test as the native server's)."""
+    from distributed_oracle_search_tpu.transport.wire import (
+        write_query_file,
+    )
+
+    conf, _ = host_conf
+    g, dc = built_index
+    queries = read_scen(conf.scenfile)
+    mine = queries[dc.worker_of(queries[:, 1]) == 0][:4]
+    fifo = str(tmp_path / "pb2b.fifo")
+    server = FifoServer(conf, 0, command_fifo=fifo)
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    import time
+    for _ in range(100):
+        if os.path.exists(fifo):
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("server fifo never appeared")
+    n = 8
+    try:
+        afifos = []
+        for k in range(n):
+            qfile = str(tmp_path / f"pb2b{k}.query")
+            afifo = str(tmp_path / f"pb2b{k}.answer")
+            write_query_file(qfile, mine)
+            os.mkfifo(afifo)
+            afifos.append(afifo)
+            with open(fifo, "w") as f:
+                f.write('{"itrs": 1, "threads": 1}\n'
+                        f"{qfile} {afifo} -\n")
+        for afifo in afifos:
+            with open(afifo) as f:
+                reply = f.readline().strip()
+            assert reply != "FAIL"
+            assert int(reply.split(",")[6]) == len(mine)
+    finally:
+        stop_server(fifo)
+        th.join(timeout=10)

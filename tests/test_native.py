@@ -386,13 +386,14 @@ def test_fifo_auto_ch(bins, dataset, tmp_path):
         proc.wait(timeout=10)
 
 
-def _start_native_server(bins, paths, idx, fifo, extra=()):
+def _start_native_server(bins, paths, idx, fifo, extra=(), env=None):
     proc = subprocess.Popen(
         [bins["fifo_auto"], "--input", paths["xy"], "--partmethod", "mod",
          "--partkey", "2", "--workerid", "0", "--maxworker", "2",
          "--outdir", idx, "--alg", "table-search", "--fifo", fifo,
          *extra],
-        stderr=subprocess.DEVNULL)
+        stderr=subprocess.DEVNULL,
+        env={**os.environ, **(env or {})})
     deadline = time.time() + 15
     while not os.path.exists(fifo):
         assert time.time() < deadline, "fifo_auto never came up"
@@ -560,10 +561,11 @@ def test_native_server_survives_dead_reader(bins, native_index, tmp_path):
     scen = read_scen(paths["scen"])
     mine = scen[dc.worker_of(scen[:, 1]) == 0][:4]
     fifo = str(tmp_path / "dr.fifo")
-    proc = _start_native_server(bins, paths, idx, fifo)
+    proc = _start_native_server(bins, paths, idx, fifo,
+                                env={"DOS_REPLY_DEADLINE_S": "2"})
     try:
         # request 1: nonexistent answer fifo, nobody will ever read it.
-        # The server waits its bounded deadline (30s) then drops.
+        # The server waits its bounded deadline (2s here) then drops.
         qfile = str(tmp_path / "dead.query")
         write_query_file(qfile, mine)
         with open(fifo, "w") as f:
@@ -577,6 +579,122 @@ def test_native_server_survives_dead_reader(bins, native_index, tmp_path):
         assert reply != "FAIL"
         assert int(reply.split(",")[6]) == len(mine)
         assert time.time() - t0 < 60
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def test_native_server_back_to_back_writers(bins, native_index, tmp_path):
+    """N separate writers in quick succession must each get a reply — the
+    reference's documented FIFO race (reference README.md:125-127): with an
+    open-to-EOF session a second writer's request could land in the dying
+    session and be silently dropped. The framed persistent-reader protocol
+    must serve all N."""
+    from distributed_oracle_search_tpu.data import Graph
+    from distributed_oracle_search_tpu.parallel import (
+        DistributionController,
+    )
+    from distributed_oracle_search_tpu.transport.wire import (
+        write_query_file,
+    )
+
+    paths, idx = native_index
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n)
+    scen = read_scen(paths["scen"])
+    mine = scen[dc.worker_of(scen[:, 1]) == 0][:4]
+    fifo = str(tmp_path / "b2b.fifo")
+    proc = _start_native_server(bins, paths, idx, fifo)
+    n = 8
+    try:
+        afifos = []
+        for k in range(n):
+            qfile = str(tmp_path / f"b2b{k}.query")
+            afifo = str(tmp_path / f"b2b{k}.answer")
+            write_query_file(qfile, mine)
+            os.mkfifo(afifo)
+            afifos.append(afifo)
+            # fresh writer per request, no pause: the old protocol would
+            # coalesce these into one session and drop all but the first
+            with open(fifo, "w") as f:
+                f.write('{"itrs": 1, "threads": 1}\n'
+                        f"{qfile} {afifo} -\n")
+        for afifo in afifos:
+            with open(afifo) as f:
+                reply = f.readline().strip()
+            assert reply != "FAIL"
+            assert int(reply.split(",")[6]) == len(mine)
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def test_native_server_resyncs_after_half_frame(bins, native_index,
+                                                tmp_path):
+    """A 1-line garbage write must not desync the framed stream: after the
+    frame timeout the server discards it and the next real request is
+    served intact."""
+    from distributed_oracle_search_tpu.data import Graph
+    from distributed_oracle_search_tpu.parallel import (
+        DistributionController,
+    )
+
+    paths, idx = native_index
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n)
+    scen = read_scen(paths["scen"])
+    mine = scen[dc.worker_of(scen[:, 1]) == 0][:4]
+    fifo = str(tmp_path / "hf.fifo")
+    proc = _start_native_server(bins, paths, idx, fifo)
+    try:
+        with open(fifo, "w") as f:
+            f.write("this is not a frame\n")   # no line 2 will follow
+        time.sleep(2.5)                        # > the 2s frame timeout
+        reply, _ = _native_request(fifo, tmp_path, mine,
+                                   '{"itrs": 1, "threads": 1}', "hf")
+        assert reply != "FAIL"
+        assert int(reply.split(",")[6]) == len(mine)
+    finally:
+        with open(fifo, "w") as fh:
+            fh.write("__DOS_STOP__\n")
+        proc.wait(timeout=10)
+
+
+def test_native_server_garbage_then_immediate_request(bins, native_index,
+                                                      tmp_path):
+    """Garbage followed IMMEDIATELY by a real request (no quiet window):
+    frame-start validation must handle the stray line standalone and serve
+    the real request intact."""
+    from distributed_oracle_search_tpu.data import Graph
+    from distributed_oracle_search_tpu.parallel import (
+        DistributionController,
+    )
+    from distributed_oracle_search_tpu.transport.wire import (
+        write_query_file,
+    )
+
+    paths, idx = native_index
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n)
+    scen = read_scen(paths["scen"])
+    mine = scen[dc.worker_of(scen[:, 1]) == 0][:4]
+    fifo = str(tmp_path / "gi.fifo")
+    proc = _start_native_server(bins, paths, idx, fifo)
+    try:
+        qfile = str(tmp_path / "gi.query")
+        afifo = str(tmp_path / "gi.answer")
+        write_query_file(qfile, mine)
+        os.mkfifo(afifo)
+        with open(fifo, "w") as f:   # garbage + real frame, one write
+            f.write("stray garbage line\n"
+                    '{"itrs": 1, "threads": 1}\n'
+                    f"{qfile} {afifo} -\n")
+        with open(afifo) as f:
+            reply = f.readline().strip()
+        assert reply != "FAIL"
+        assert int(reply.split(",")[6]) == len(mine)
     finally:
         with open(fifo, "w") as fh:
             fh.write("__DOS_STOP__\n")
