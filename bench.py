@@ -176,20 +176,37 @@ def main() -> None:
     bench_table = os.environ.get("BENCH_TABLE", "1") != "0"
 
     # warm-up at the full scenario shape: compiles each query program once,
-    # like the reference's resident fifo_auto loading before the campaign
+    # like the reference's resident fifo_auto loading before the campaign.
+    # Timed PER PROGRAM so compile regressions are attributable; the table
+    # section warms itself up later — its large prepare program used to
+    # run here and skewed both this number and the walk timings after it
+    warmups = {}
     with Timer() as t_compile:
-        oracle.query(queries)
-        oracle.query(queries, w_query=w_diff)
-        oracle.query_dist(queries)
-        if bench_table:
-            warm = oracle.prepare_weights(w_diff)
-            oracle.query_table(warm, queries)
-            jax.block_until_ready(warm[0])
-            del warm
-    log(f"query warm-up (compile): {t_compile}")
+        with Timer() as tw:
+            oracle.query(queries)
+        warmups["walk"] = round(tw.interval, 2)
+        with Timer() as tw:
+            oracle.query(queries, w_query=w_diff)
+        warmups["walk_diff"] = round(tw.interval, 2)
+        with Timer() as tw:
+            oracle.query_dist(queries)
+        warmups["dist"] = round(tw.interval, 2)
+    log(f"query warm-up (compile): {t_compile} "
+        + " ".join(f"{k}={v}s" for k, v in warmups.items()))
 
-    with Timer() as t_scen:
-        cost, plen, finished = oracle.query(queries)
+    def best_of(fn, reps: int = 3):
+        """Best-of-N timing: single-shot numbers on a tunneled device link
+        jitter by 10-20%; the minimum is the reproducible figure."""
+        out = None
+        best = None
+        for _ in range(reps):
+            with Timer() as tt:
+                out = fn()
+            if best is None or tt.interval < best.interval:
+                best = tt
+        return out, best
+
+    (cost, plen, finished), t_scen = best_of(lambda: oracle.query(queries))
     n_fin = int(finished.sum())
     qps = n_queries / t_scen.interval
     mean_plen = float(plen.mean())
@@ -197,15 +214,14 @@ def main() -> None:
         f"finished {n_fin}/{n_queries}, mean plen {mean_plen:.1f}")
     assert n_fin == n_queries, "benchmark correctness gate failed"
 
-    with Timer() as t_diff:
-        cost_d, plen_d, fin_d = oracle.query(queries, w_query=w_diff)
+    (cost_d, plen_d, fin_d), t_diff = best_of(
+        lambda: oracle.query(queries, w_query=w_diff))
     assert int(fin_d.sum()) == n_queries
     assert (cost_d >= cost).all(), "diffed costs must dominate free flow"
     log(f"walk diffed:   {n_queries} in {t_diff} -> "
         f"{n_queries / t_diff.interval:,.0f} q/s")
 
-    with Timer() as t_dist:
-        cost_g, fin_g = oracle.query_dist(queries)
+    (cost_g, fin_g), t_dist = best_of(lambda: oracle.query_dist(queries))
     assert (cost_g == cost).all(), "dist fast path must match the walk"
     log(f"dist gather:   {n_queries} in {t_dist} -> "
         f"{n_queries / t_dist.interval:,.0f} q/s")
@@ -213,14 +229,41 @@ def main() -> None:
     # ---- roofline: the walk does ~3 scalar gathers per step per query
     # (fm slot, per-slot weight, next node); compare achieved rate to a
     # calibrated dependent-gather micro-kernel of the same shape
+    from distributed_oracle_search_tpu.ops.table_search import pick_buckets
+
     peak_gather = _calibrate_gather(g.n, n_queries)
     hbm_bw = _calibrate_hbm()
-    # the lock-step walk runs max-plen steps for the batch; gathers issued
-    # scale with batch width x steps (halted lanes still occupy lanes)
-    steps_run = float(plen.max())
-    achieved_gather = n_queries * mean_plen * 3 / t_scen.interval
-    issued_gather = n_queries * steps_run * 3 / t_scen.interval
-    log(f"roofline: peak gather {peak_gather / 1e6:,.0f} M elem/s, "
+    # device-kernel time WITHOUT the host round trip: the end-to-end walk
+    # pays a fixed ~90 ms device->host fetch on this tunneled link, which
+    # is transport, not kernel — utilization is a kernel property
+    from distributed_oracle_search_tpu.parallel.sharded import (
+        query_sharded,
+    )
+    ra, sa, ta, va, _ = oracle.route(queries)
+    _, t_kern = best_of(lambda: jax.block_until_ready(query_sharded(
+        oracle.dg, oracle.fm, ra, sa, ta, va, oracle.dg.w_pad,
+        oracle.mesh)))
+    # the bucketed walk (ops.table_search n_buckets) runs each bucket to
+    # its OWN max length: reconstruct issued gathers from route()'s
+    # actual per-device layout (each (data, worker) plane is an
+    # est-sorted, separately padded [qmax] column). Utilization compares
+    # the CRITICAL-PATH device (max lanes) to the single-device peak.
+    _, _, _, valid_dwq, (act, sd, sw, sq) = oracle.route(queries)
+    dgrid, wgrid, qmax = valid_dwq.shape
+    plen_dwq = np.zeros((dgrid, wgrid, qmax))
+    plen_dwq[sd[act], sw[act], sq[act]] = np.asarray(plen)[act]
+    b = pick_buckets(qmax, 0)
+    qb = qmax // b
+    unroll = 8
+    per_bucket_max = plen_dwq.reshape(dgrid, wgrid, b, qb).max(axis=3)
+    lanes_dev = (np.ceil(per_bucket_max / unroll) * unroll).sum(
+        axis=2) * qb                                  # [D, W] per device
+    lanes_issued = float(lanes_dev.max())
+    achieved_gather = (n_queries / (dgrid * wgrid)) * mean_plen * 3 \
+        / t_kern.interval
+    issued_gather = lanes_issued * 3 / t_kern.interval
+    log(f"roofline: kernel {t_kern.interval:.3f}s, peak gather "
+        f"{peak_gather / 1e6:,.0f} M elem/s, "
         f"useful {achieved_gather / 1e6:,.0f} "
         f"({achieved_gather / peak_gather:.0%}), issued "
         f"{issued_gather / 1e6:,.0f} ({issued_gather / peak_gather:.0%}); "
@@ -232,11 +275,22 @@ def main() -> None:
     # BENCH_TABLE=0 skips it for quick runs.
     table_stats = {}
     if bench_table:
+        # warm-up: compile the prepare/lookup programs at shape on the
+        # free-flow weights, so the timed run below is steady-state (and
+        # the compile cost is attributable here, not smeared into it)
+        with Timer() as t_tabc:
+            warm = oracle.prepare_weights(None)
+            # full scenario shape: a different batch size would compile a
+            # different lookup program and the timed run would pay it
+            oracle.query_table(warm, queries)
+            jax.block_until_ready(warm[0])
+            del warm
+        log(f"table warm-up (compile): {t_tabc}")
         with Timer() as t_prep:
             tables = oracle.prepare_weights(w_diff)
             jax.block_until_ready(tables[0])
-        with Timer() as t_tab:
-            cost_t, plen_t, fin_t = oracle.query_table(tables, queries)
+        (cost_t, plen_t, fin_t), t_tab = best_of(
+            lambda: oracle.query_table(tables, queries))
         assert (cost_t == cost_d).all(), \
             "table path must match the diff walk"
         assert (plen_t == plen_d).all() and (fin_t == fin_d).all()
@@ -350,12 +404,14 @@ def main() -> None:
             "graph_edges": g.m,
             "n_queries": n_queries,
             "scenario_seconds": round(t_scen.interval, 4),
+            "warmup_seconds": warmups,
             "diff_queries_per_sec": round(n_queries / t_diff.interval, 1),
             "dist_queries_per_sec": round(n_queries / t_dist.interval, 1),
             **table_stats,
             "cpd_build_seconds": round(t_build.interval, 2),
             "cpd_rows_per_sec": round(rows_per_s, 1),
             "roofline": {
+                "kernel_seconds": round(t_kern.interval, 4),
                 "peak_gather_meps": round(peak_gather / 1e6, 1),
                 "walk_useful_gather_meps": round(achieved_gather / 1e6, 1),
                 "walk_issued_gather_meps": round(issued_gather / 1e6, 1),

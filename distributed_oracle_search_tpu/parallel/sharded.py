@@ -235,8 +235,7 @@ def query_tables_sharded(tables, t_rows, s, valid, mesh: Mesh):
     """Answer routed [D, W, Q] queries from prepared cost tables."""
     cost, plen, fin = tables
     qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
-    rows_d, s_d, v_d = (jax.device_put(jnp.asarray(a), qs)
-                        for a in (t_rows, s, valid))
+    rows_d, s_d, v_d = jax.device_put((t_rows, s, valid), qs)
     return _query_table_fn(mesh)(cost, plen, fin, rows_d, s_d, v_d)
 
 
@@ -272,7 +271,7 @@ def query_paths_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
     ``--k-moves`` extraction, reference ``args.py:31-36``, batched).
     """
     qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
-    args = [jax.device_put(jnp.asarray(a), qs) for a in (t_rows, s, t)]
+    args = jax.device_put((t_rows, s, t), qs)
     return _paths_fn(mesh, k)(dg, fm_wrn, *args)
 
 
@@ -302,7 +301,7 @@ def query_dist_sharded(dist_wrn: jax.Array, t_rows: np.ndarray,
     ``[D, W, Q]`` (INF where unreachable).
     """
     qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
-    rows_d, s_d = (jax.device_put(jnp.asarray(a), qs) for a in (t_rows, s))
+    rows_d, s_d = jax.device_put((t_rows, s), qs)
     return _query_dist_fn(mesh)(dist_wrn, rows_d, s_d)
 
 
@@ -338,8 +337,11 @@ def query_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
     padding. Returns ``(cost, plen, finished)`` each ``[D, W, Q]``.
     """
     qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
-    args = [jax.device_put(jnp.asarray(a), qs)
-            for a in (t_rows, s, t, valid)]
+    # ONE device_put for the whole query pack: each separate transfer
+    # costs a fixed round trip (~25-90 ms over a tunneled TPU link);
+    # and never jnp.asarray first — that is a second, default-device
+    # transfer before the resharding copy
+    args = jax.device_put((t_rows, s, t, valid), qs)
     fn = _query_fn(mesh, max_steps)
     return fn(dg, fm_wrn, *args, jnp.asarray(w_query_pad),
               jnp.int32(k_moves))

@@ -217,6 +217,10 @@ class FifoServer:
             fcntl.fcntl(fd, fcntl.F_SETFL,
                         fcntl.fcntl(fd, fcntl.F_GETFL) & ~os.O_NONBLOCK)
             os.write(fd, line.encode())
+        except OSError as e:
+            # reader vanished between open and write (BrokenPipe):
+            # drop the reply, never crash the serve loop
+            log.error("reply to %s failed: %s", answerfifo, e)
         finally:
             os.close(fd)
 

@@ -26,6 +26,7 @@
 // drivers cannot tell the two apart. --compress keeps the shard
 // run-length-encoded in memory (the reference's CPD compression trade).
 
+#include <csignal>
 #include <fcntl.h>
 #include <omp.h>
 #include <poll.h>
@@ -474,6 +475,9 @@ struct Server {
 };
 
 static int real_main(int argc, char** argv) {
+    // a reply/FAIL write to an answer FIFO whose reader vanished between
+    // our open() and write() must error with EPIPE, not kill the server
+    ::signal(SIGPIPE, SIG_IGN);
     std::string input, diff = "-", partmethod, outdir = ".", alg =
         "table-search", fifo;
     std::vector<int64_t> partkey;

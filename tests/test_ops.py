@@ -131,3 +131,58 @@ def test_unreachable_batch():
         dg, fm, jnp.asarray([0]), jnp.asarray([0]), jnp.asarray([3]),
         dg.w_pad)
     assert not np.asarray(fin)[0] and np.asarray(plen)[0] == 0
+
+
+def test_bucketed_walk_invariant(toy_graph, dg, toy_queries):
+    """n_buckets must never change answers — same results for 1, explicit
+    B, auto, with k_moves budgets and valid padding, odd batch sizes."""
+    from distributed_oracle_search_tpu.ops.table_search import pick_buckets
+
+    g = toy_graph
+    targets = np.arange(g.n, dtype=np.int32)
+    fm = build_fm_columns(dg, jnp.asarray(targets))
+    # replicate queries to a biggish batch with an odd size
+    q = np.tile(toy_queries, (41, 1))[:257]
+    s = jnp.asarray(q[:, 0], jnp.int32)
+    t = jnp.asarray(q[:, 1], jnp.int32)
+    valid = jnp.asarray(np.arange(len(q)) % 5 != 3)
+    for k_moves in (-1, 2):
+        ref = table_search_batch(dg, fm, t, s, t, dg.w_pad, valid=valid,
+                                 k_moves=k_moves, n_buckets=1)
+        for b in (0, 2, 4, 16):
+            got = table_search_batch(dg, fm, t, s, t, dg.w_pad,
+                                     valid=valid, k_moves=k_moves,
+                                     n_buckets=b)
+            for a, r in zip(got, ref):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(r))
+    # odd sizes fall back to a divisor (257 is prime -> 1 bucket)
+    assert pick_buckets(257, 0) == 1
+    assert pick_buckets(65536, 0) == 32
+    assert pick_buckets(8192, 0) == 8
+    assert pick_buckets(100, 6) == 5
+
+
+def test_route_sorts_by_length_estimate(toy_graph):
+    """route() orders each worker group by the coordinate-distance
+    estimate (slot_q ascends with expected walk length) and still
+    scatters answers back to input order."""
+    from distributed_oracle_search_tpu.models.cpd import CPDOracle
+    from distributed_oracle_search_tpu.parallel import (
+        DistributionController,
+    )
+    from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+
+    g = toy_graph
+    dc = DistributionController("mod", 1, 1, g.n)
+    o = CPDOracle(g, dc, mesh=make_mesh(n_workers=1))
+    rng = np.random.default_rng(0)
+    q = np.stack([rng.integers(0, g.n, 64), rng.integers(0, g.n, 64)],
+                 axis=1)
+    r_arr, s_arr, t_arr, valid, scatter = o.route(q)
+    est = o._length_estimate(q)
+    active, sd, sw, sq = scatter
+    # same (d) lane: higher slot_q => est must not decrease
+    for d in range(r_arr.shape[0]):
+        lane = np.nonzero((sd == d) & active)[0]
+        order = np.argsort(sq[lane])
+        assert (np.diff(est[lane][order]) >= 0).all()
