@@ -83,7 +83,12 @@ def dist_to_targets(dg: DeviceGraph, targets: jnp.ndarray,
             new = _relax_nb(new, dg)
         return i + unroll, new, jnp.any(new < dist)
 
-    _, dist_nb, _ = jax.lax.while_loop(cond, body, (jnp.int32(0), dist0, True))
+    # data-derived seed: varying under shard_map (a literal True has
+    # replicated type and the carry check rejects it), True iff any valid
+    # target row exists
+    seed = jnp.any(dist0 < JINF)
+    _, dist_nb, _ = jax.lax.while_loop(cond, body,
+                                       (jnp.int32(0), dist0, seed))
     return dist_nb.T
 
 

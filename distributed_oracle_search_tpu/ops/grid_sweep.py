@@ -161,7 +161,10 @@ def _sweep_dist_fn(h: int, w: int, shifts: tuple, n_left: int,
                 out[gi] = prev
             return prev, jnp.stack(out)
 
-        init = jnp.full(sk.shape[1:], JINF, jnp.int32)
+        # data-derived init (sk*0 + JINF): under shard_map a constant
+        # carry has replicated type while the body output is
+        # mesh-varying, and the scan carry check rejects the mix
+        init = sk[0] * 0 + JINF
         _, out = jax.lax.scan(step, init,
                               (blk(sk), blk(w_same), blk(w_cross)),
                               reverse=reverse)

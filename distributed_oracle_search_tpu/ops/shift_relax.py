@@ -103,8 +103,12 @@ def _dist_fn(shifts: tuple, n: int, k_left: int, max_iters: int):
             nd = relax(d, w_shift, nbr_left, w_left)
             return i + 1, nd, jnp.any(nd < d)
 
+        # data-derived seed: varying under shard_map (a literal True has
+        # replicated type and the carry check rejects it), True iff any
+        # valid target row exists
+        seed = jnp.any(dist0 < JINF)
         _, d, _ = jax.lax.while_loop(cond, body,
-                                     (jnp.int32(0), dist0, True))
+                                     (jnp.int32(0), dist0, seed))
         return d.T
 
     return dist_to_targets_shift

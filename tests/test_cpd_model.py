@@ -163,3 +163,28 @@ def test_query_dist_requires_store(toy_graph, toy_queries):
                        mesh=make_mesh(n_workers=2)).build()
     with _pytest.raises(RuntimeError, match="store_dists"):
         oracle.query_dist(toy_queries)
+
+
+def test_build_program_has_no_collectives(toy_graph):
+    """The sharded build must be embarrassingly parallel: per-shard
+    while_loop convergence, ZERO cross-shard traffic. A GSPMD-jit build
+    once carried a global convergence flag — an all-reduce per sweep and
+    slowest-shard coupling (the round-2 weak-scaling regression). Pin the
+    property in the compiled HLO."""
+    from distributed_oracle_search_tpu.ops import DeviceGraph
+    from distributed_oracle_search_tpu.parallel.sharded import (
+        _build_fn, pad_targets,
+    )
+
+    g = toy_graph
+    dc = DistributionController("tpu", None, 8, g.n)
+    mesh = make_mesh(n_workers=8)
+    dg = DeviceGraph.from_graph(g)
+    tgt = pad_targets(dc)
+    import jax.numpy as jnp
+    fn = _build_fn(mesh, 8, 0, False)
+    compiled = fn.lower(dg, jnp.asarray(tgt.T)).compile()
+    hlo = compiled.as_text()
+    for op in ("all-reduce", "all-gather", "collective-permute",
+               "all-to-all", "reduce-scatter"):
+        assert op not in hlo, f"build program contains a {op} collective"
