@@ -594,6 +594,147 @@ def main() -> None:
         finally:
             shutil.rmtree(outdir, ignore_errors=True)
 
+    # ---- road section: non-grid, degree-skewed 264k-node network (the
+    # DIMACS stand-in, BASELINE.md configs[5]) — the regime where the
+    # grid/shift build gates MUST fall back gracefully. Build via the ELL
+    # fallback on TPU vs per-source Dijkstra on CPU; serve streamed and
+    # resident from the same index. BENCH_ROAD=0 skips.
+    road_stats = {}
+    if os.environ.get("BENCH_ROAD", "1") != "0":
+        import shutil
+        import tempfile
+
+        import jax.numpy as jnp
+
+        from distributed_oracle_search_tpu.data import synth_road_network
+        from distributed_oracle_search_tpu.models.cpd import (
+            pick_build_kernel, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.models.streamed import (
+            StreamedCPDOracle,
+        )
+        from distributed_oracle_search_tpu.ops import DeviceGraph
+        from distributed_oracle_search_tpu.ops.shift_relax import (
+            split_coverage,
+        )
+        from distributed_oracle_search_tpu.ops.table_search import (
+            table_search_batch,
+        )
+
+        rn = int(os.environ.get("BENCH_ROAD_NODES", 264_000))
+        g3 = synth_road_network(rn, seed=0)
+        _, ws_raw, _, wl_raw = g3.shift_split()
+        cov_raw = split_coverage(ws_raw, wl_raw)
+        with Timer() as t_rcm:
+            g3 = g3.reorder(g3.rcm_order())
+        _, ws_rcm, _, wl_rcm = g3.shift_split()
+        cov_rcm = split_coverage(ws_rcm, wl_rcm)
+        kind3, _ = pick_build_kernel(g3, "auto")
+        log(f"road: n={g3.n} m={g3.m} K={g3.max_out_degree}; rcm reorder "
+            f"{t_rcm}; shift coverage {cov_raw:.1%} -> {cov_rcm:.1%}; "
+            f"auto build kernel = {kind3} (gates fell back as designed)")
+
+        sub = 512                       # rows per serving sub-worker
+        mw3 = -(-g3.n // sub)
+        dc3 = DistributionController("div", sub, mw3, g3.n)
+        out3 = tempfile.mkdtemp(prefix="dos-road-")
+        try:
+            # TPU build: the ELL fallback, 64 timed rows (irregular
+            # graphs are the gather-hostile regime; honesty is the point)
+            trows = 64
+            dg3 = DeviceGraph.from_graph(g3)
+            from distributed_oracle_search_tpu.ops import build_fm_columns
+            tgt64 = np.arange(trows, dtype=np.int32)
+            jax.block_until_ready(
+                build_fm_columns(dg3, jnp.asarray(tgt64)))   # compile
+            with Timer() as t_b3:
+                fm64 = np.asarray(build_fm_columns(
+                    dg3, jnp.asarray(tgt64)))
+            tpu_rps3 = trows / t_b3.interval
+            log(f"road TPU build (ell): {trows} rows in {t_b3} -> "
+                f"{tpu_rps3:,.1f} rows/s")
+
+            bins = (_native_bins()
+                    if os.environ.get("BENCH_CPU", "1") != "0" else None)
+            if bins is not None:
+                xy3 = os.path.join(out3, "road.xy")
+                write_xy(xy3, g3.xs, g3.ys, g3.src, g3.dst, g3.w)
+                with Timer() as t_cb3:
+                    subprocess.run(
+                        [bins["make_cpd_auto"], "--input", xy3,
+                         "--partmethod", "div", "--partkey", str(sub),
+                         "--workerid", "0", "--maxworker", str(mw3),
+                         "--outdir", out3],
+                        check=True, capture_output=True)
+                cpu_rps3 = sub / t_cb3.interval
+                # correctness gate: ELL build and native Dijkstra must
+                # produce bit-identical first moves on this graph too
+                blk0 = np.load(os.path.join(
+                    out3, "cpd-w00000-b00000.npy"))
+                assert (blk0[:trows] == fm64).all(), \
+                    "road: TPU ELL fm rows != native Dijkstra rows"
+                log(f"road CPU build: {sub} rows in {t_cb3} -> "
+                    f"{cpu_rps3:,.1f} rows/s (tpu "
+                    f"{tpu_rps3 / cpu_rps3:.2f}x); fm parity ok")
+
+                write_index_manifest(out3, dc3, workers=[0])
+                rng = np.random.default_rng(5)
+                rq = int(os.environ.get("BENCH_ROAD_QUERIES", 20_000))
+                q3 = np.stack([rng.integers(0, g3.n, rq),
+                               rng.integers(0, sub, rq)], axis=1)
+                st3 = StreamedCPDOracle(g3, dc3, out3, row_chunk=512)
+                st3.query(q3[:256])
+                with Timer() as t_q3:
+                    c3, p3, f3 = st3.query(q3)
+                assert bool(f3.all())
+                log(f"road streamed: {rq} in {t_q3} -> "
+                    f"{rq / t_q3.interval:,.0f} q/s")
+
+                # resident worker-0 shard (135 MB) — the per-chip unit
+                fm0r = jnp.asarray(blk0)
+                est3 = (np.abs(g3.xs[q3[:, 0]] - g3.xs[q3[:, 1]])
+                        + np.abs(g3.ys[q3[:, 0]] - g3.ys[q3[:, 1]]))
+                o3 = np.argsort(est3, kind="stable")
+                qp3 = 1 << (rq - 1).bit_length()
+                rr3 = np.zeros(qp3, np.int32)
+                ss3 = np.zeros(qp3, np.int32)
+                tt3 = np.zeros(qp3, np.int32)
+                vv3 = np.zeros(qp3, bool)
+                rr3[:rq] = q3[o3, 1]
+                ss3[:rq] = q3[o3, 0]
+                tt3[:rq] = q3[o3, 1]
+                vv3[:rq] = True
+                (cr3, pr3, fr3), t_r3 = best_of(
+                    lambda: jax.block_until_ready(table_search_batch(
+                        dg3, fm0r, rr3, ss3, tt3, dg3.w_pad, valid=vv3)))
+                assert bool(np.asarray(fr3)[:rq].all())
+                assert (np.asarray(cr3)[np.argsort(o3)] == c3).all()
+                rqps3 = rq / t_r3.interval
+                t_cq3 = _cpu_query_campaign(
+                    bins, xy3, out3, q3, out3, partmethod="div",
+                    partkey=sub, workerid=0, maxworker=mw3)
+                log(f"road resident: {rq} in {t_r3} -> {rqps3:,.0f} q/s; "
+                    f"CPU campaign {t_cq3:.3f}s -> "
+                    f"{rq / t_cq3:,.0f} q/s (tpu resident "
+                    f"{t_cq3 / t_r3.interval:.2f}x)")
+                road_stats = {
+                    "road_nodes": g3.n,
+                    "road_edges": g3.m,
+                    "road_shift_coverage_raw": round(cov_raw, 4),
+                    "road_shift_coverage_rcm": round(cov_rcm, 4),
+                    "road_build_kernel": kind3,
+                    "road_tpu_build_rows_per_sec": round(tpu_rps3, 2),
+                    "road_cpu_build_rows_per_sec": round(cpu_rps3, 2),
+                    "road_stream_queries_per_sec": round(
+                        rq / t_q3.interval, 1),
+                    "road_resident_queries_per_sec": round(rqps3, 1),
+                    "road_cpu_queries_per_sec": round(rq / t_cq3, 1),
+                    "road_tpu_resident_speedup": round(
+                        t_cq3 / t_r3.interval, 3),
+                }
+        finally:
+            shutil.rmtree(out3, ignore_errors=True)
+
     # ---- weak scaling: same total rows over 1/2/4/8 virtual CPU devices
     weak_stats = {}
     if os.environ.get("BENCH_WEAK", "1") != "0":
@@ -633,6 +774,7 @@ def main() -> None:
                 "hbm_stream_gbps": round(hbm_bw / 1e9, 1),
             },
             **scale_stats,
+            **road_stats,
             **weak_stats,
             "devices": len(devices),
             "platform": devices[0].platform,

@@ -95,6 +95,12 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                        help="Scenario file to read from.")
     files.add_argument("--diff", type=str,
                        help="Travel-time diff file for the search.")
+    files.add_argument("--order", type=str, default=None,
+                       help="Node ordering: bfs | rcm | order-file "
+                            "(reference args.py:119 NodeOrdering). "
+                            "Datasets are reordered up front by "
+                            "cli.reorder; this flag names the ordering "
+                            "that produced them.")
 
     rand = p.add_argument_group("random")
     rand.add_argument("-R", "--random", action="store_true",

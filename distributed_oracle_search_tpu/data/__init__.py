@@ -3,12 +3,14 @@ from .formats import (
     read_xy, write_xy, read_scen, write_scen, read_diff, write_diff,
     xy_node_count,
 )
-from .synth import (synth_city_graph, synth_scenario, synth_diff,
-                    ensure_synth_dataset)
+from .synth import (synth_city_graph, synth_road_network, synth_scenario,
+                    synth_diff, ensure_synth_dataset)
+from .dimacs import graph_from_dimacs, read_co, read_gr
 
 __all__ = [
     "Graph", "read_xy", "write_xy", "read_scen", "write_scen",
     "read_diff", "write_diff", "xy_node_count",
-    "synth_city_graph", "synth_scenario", "synth_diff",
-    "ensure_synth_dataset",
+    "synth_city_graph", "synth_road_network", "synth_scenario",
+    "synth_diff", "ensure_synth_dataset",
+    "graph_from_dimacs", "read_co", "read_gr",
 ]

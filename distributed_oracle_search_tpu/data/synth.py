@@ -63,6 +63,89 @@ def synth_city_graph(width: int, height: int, seed: int = 0,
     return Graph(xs, ys, src, dst, w)
 
 
+def synth_road_network(n: int, seed: int = 0) -> Graph:
+    """Non-grid, degree-skewed, planar-ish road network — the DIMACS
+    stand-in (BASELINE.md configs[5] is USA-road-d.NY, 264k nodes; the
+    real file is absent from the snapshot).
+
+    Topology-realistic where the grid city is not: towns of clustered
+    density, a connected backbone of local roads whose edges are bridges
+    (long detours when congested), a long-tailed degree distribution
+    (hub intersections), and a sparse highway layer between town centers.
+    NOT id-ordered for the fast build kernels on purpose — the point of
+    the DIMACS regime is that ``grid_split`` fails and shift coverage is
+    poor until a BFS/RCM reorder (``Graph.rcm_order``) restores id
+    locality, exactly like real road inputs.
+    """
+    rng = np.random.default_rng(seed)
+    n_towns = max(4, n // 2000)
+    centers = rng.uniform(0, 4_000_000, (n_towns, 2))
+    town = rng.integers(0, n_towns, n)
+    spread = rng.gamma(2.0, 12_000, n)
+    ang = rng.uniform(0, 2 * np.pi, n)
+    xs = (centers[town, 0] + spread * np.cos(ang)).astype(np.int64)
+    ys = (centers[town, 1] + spread * np.sin(ang)).astype(np.int64)
+
+    # spatial snake order (bands of y, then x) gives a locality window
+    # without a kd-tree; ids are then SHUFFLED so the stored graph has no
+    # exploitable id structure (that is what reordering is for)
+    band = ys // 25_000
+    space = np.lexsort((xs, band))
+
+    # connected backbone: each node (in space order) links to a random
+    # earlier node within a short window -> spanning tree of local roads
+    i = np.arange(1, n)
+    back = i - 1 - np.minimum(rng.geometric(0.3, n - 1) - 1, np.minimum(i - 1, 63))
+    su = [space[i], ]
+    sv = [space[back], ]
+
+    # degree skew: a long-tailed number of extra local edges per node
+    # (most 0-1, hubs up to ~12)
+    extra = np.minimum(rng.zipf(2.2, n) - 1, 12)
+    tot = int(extra.sum())
+    owner = np.repeat(np.arange(n), extra)          # position in space order
+    off = rng.integers(1, 48, tot)
+    nbr = np.clip(owner - off, 0, n - 1)
+    keep = nbr != owner
+    su.append(space[owner[keep]])
+    sv.append(space[nbr[keep]])
+
+    # highway layer: town centers chained by proximity order + a few
+    # random long links
+    hub = np.empty(n_towns, np.int64)
+    d2 = (xs - centers[town, 0]) ** 2 + (ys - centers[town, 1]) ** 2
+    for t in range(n_towns):                        # one pass, small loop
+        members = np.nonzero(town == t)[0]
+        hub[t] = members[np.argmin(d2[members])] if len(members) else 0
+    horder = np.lexsort((centers[:, 0], centers[:, 1] // 400_000))
+    su.append(hub[horder[:-1]])
+    sv.append(hub[horder[1:]])
+    k_long = max(1, n_towns // 8)
+    su.append(hub[rng.integers(0, n_towns, k_long)])
+    sv.append(hub[rng.integers(0, n_towns, k_long)])
+
+    su = np.concatenate(su)
+    sv = np.concatenate(sv)
+    ok = su != sv
+    su, sv = su[ok], sv[ok]
+    src = np.concatenate([su, sv])
+    dst = np.concatenate([sv, su])
+    key = src * n + dst
+    _, uniq = np.unique(key, return_index=True)
+    src, dst = src[uniq], dst[uniq]
+
+    dx = (xs[src] - xs[dst]).astype(np.float64)
+    dy = (ys[src] - ys[dst]).astype(np.float64)
+    dist = np.sqrt(dx * dx + dy * dy)
+    jitter = 1.0 + 0.3 * rng.random(len(src))
+    w = np.maximum(1, (dist * jitter / 100.0).astype(np.int64))
+    w = np.minimum(w, 2_000_000).astype(np.int32)
+
+    # destroy id locality: real DIMACS inputs arrive in arbitrary order
+    shuf = rng.permutation(n)
+    return Graph(xs, ys, src, dst, w).reorder(shuf)
+
+
 def synth_scenario(n_nodes: int, n_queries: int, seed: int = 1) -> np.ndarray:
     """Random s–t pairs with s != t, int64 [Q, 2]."""
     rng = np.random.default_rng(seed)

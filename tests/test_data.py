@@ -104,3 +104,140 @@ def test_synth_city_strongly_connected_small():
     assert d.max() < INF  # reachable from 0
     dr = dijkstra(g, 0, reverse=True)
     assert dr.max() < INF  # 0 reachable from all
+
+
+# ------------------------------------------------------------- DIMACS
+
+def _write_dimacs(tmp_path, g):
+    gr = str(tmp_path / "t.gr")
+    co = str(tmp_path / "t.co")
+    with open(gr, "w") as f:
+        f.write("c test graph\n")
+        f.write(f"p sp {g.n} {g.m}\n")
+        for u, v, w in zip(g.src, g.dst, g.w):
+            f.write(f"a {u + 1} {v + 1} {w}\n")
+    with open(co, "w") as f:
+        f.write(f"p aux sp co {g.n}\n")
+        for i, (x, y) in enumerate(zip(g.xs, g.ys)):
+            f.write(f"v {i + 1} {x} {y}\n")
+    return gr, co
+
+
+def test_dimacs_roundtrip(tmp_path, toy_graph):
+    from distributed_oracle_search_tpu.data import graph_from_dimacs
+
+    g = toy_graph
+    gr, co = _write_dimacs(tmp_path, g)
+    g2 = graph_from_dimacs(gr, co)
+    assert g2.n == g.n and g2.m == g.m
+    np.testing.assert_array_equal(g2.xs, g.xs)
+    np.testing.assert_array_equal(g2.ys, g.ys)
+    # same edge multiset (construction may reorder)
+    k1 = np.sort(g.src * g.n + g.dst)
+    k2 = np.sort(g2.src * g2.n + g2.dst)
+    np.testing.assert_array_equal(k1, k2)
+
+
+def test_dimacs_converter_cli(tmp_path, toy_graph):
+    from distributed_oracle_search_tpu.data import Graph
+    from distributed_oracle_search_tpu.data.dimacs import main as dmain
+
+    g = toy_graph
+    gr, co = _write_dimacs(tmp_path, g)
+    out = str(tmp_path / "conv.xy")
+    assert dmain(["--gr", gr, "--co", co, "-o", out]) == 0
+    g2 = Graph.from_xy(out)
+    assert g2.n == g.n and g2.m == g.m
+
+
+def test_dimacs_without_coordinates(tmp_path, toy_graph):
+    from distributed_oracle_search_tpu.data import graph_from_dimacs
+
+    gr, _ = _write_dimacs(tmp_path, toy_graph)
+    g2 = graph_from_dimacs(gr)
+    assert (g2.xs == 0).all() and g2.m == toy_graph.m
+
+
+# ----------------------------------------------------------- reordering
+
+def test_reorder_preserves_shortest_paths(toy_graph):
+    from distributed_oracle_search_tpu.models.reference import (
+        dist_to_target,
+    )
+
+    g = toy_graph
+    rng = np.random.default_rng(3)
+    perm = rng.permutation(g.n)
+    g2 = g.reorder(perm)
+    inv = np.empty(g.n, np.int64)
+    inv[perm] = np.arange(g.n)
+    for t in (0, 7, g.n - 1):
+        d1 = dist_to_target(g, t)
+        d2 = dist_to_target(g2, int(inv[t]))
+        np.testing.assert_array_equal(d1, d2[inv])
+
+
+def test_orders_are_permutations_and_rcm_reduces_bandwidth(toy_graph):
+    g0 = toy_graph
+    rng = np.random.default_rng(9)
+    g = g0.reorder(rng.permutation(g0.n))   # destroy locality
+    for perm in (g.bfs_order(), g.rcm_order()):
+        assert np.array_equal(np.sort(perm), np.arange(g.n))
+
+    def bandwidth(gg):
+        return int(np.abs(gg.src - gg.dst).max())
+
+    g_rcm = g.reorder(g.rcm_order())
+    assert bandwidth(g_rcm) < bandwidth(g)
+
+
+def test_reorder_cli_remaps_dataset(tmp_path, toy_graph):
+    from distributed_oracle_search_tpu.cli.reorder import main as rmain
+    from distributed_oracle_search_tpu.data import (
+        Graph, read_scen, write_scen, write_xy,
+    )
+    from distributed_oracle_search_tpu.models.reference import (
+        dist_to_target, first_move_to_target, table_search_walk,
+    )
+
+    g = toy_graph
+    xy = str(tmp_path / "g.xy")
+    write_xy(xy, g.xs, g.ys, g.src, g.dst, g.w)
+    scen_in = str(tmp_path / "in.scen")
+    rng = np.random.default_rng(1)
+    q = np.stack([rng.integers(0, g.n, 16), rng.integers(0, g.n, 16)],
+                 axis=1)
+    write_scen(scen_in, q)
+    out = str(tmp_path / "g-rcm.xy")
+    scen_out = str(tmp_path / "out.scen")
+    assert rmain(["--input", xy, "--order", "rcm", "-o", out,
+                  "--scen", scen_in, scen_out]) == 0
+    g2 = Graph.from_xy(out)
+    q2 = read_scen(scen_out)
+    perm = np.loadtxt(out + ".order", dtype=np.int64)
+    assert np.array_equal(np.sort(perm), np.arange(g.n))
+    # remapped queries answer with the SAME costs as the originals
+    for (s, t), (s2, t2) in zip(q[:6], q2[:6]):
+        assert dist_to_target(g, int(t))[s] == \
+            dist_to_target(g2, int(t2))[s2]
+
+
+def test_synth_road_network_properties():
+    from distributed_oracle_search_tpu.data import synth_road_network
+
+    g = synth_road_network(4000, seed=0)
+    assert g.grid_split() is None           # non-grid by construction
+    deg = np.diff(g.out_ptr)
+    assert deg.max() >= 10                  # degree-skewed (hubs)
+    assert np.percentile(deg, 50) <= 6
+    # single strongly-connected-ish component: BFS from node 0 reaches all
+    ptr, nbr = g._undirected_csr()
+    seen = np.zeros(g.n, bool)
+    seen[0] = True
+    frontier = np.array([0])
+    while len(frontier):
+        nxt = g.frontier_neighbors(ptr, nbr, frontier)
+        nxt = nxt[~seen[nxt]]
+        seen[nxt] = True
+        frontier = nxt
+    assert seen.all(), "road network must be connected"
