@@ -80,8 +80,15 @@ def read_co(path: str):
         for line in f:
             tag = line[:1]
             if tag == "v":
+                if xs is None:
+                    raise ValueError(
+                        f"{path}: vertex before 'p aux sp co' line")
                 _, i, x, y = line.split()
                 idx = int(i) - 1
+                if not 0 <= idx < n:
+                    raise ValueError(
+                        f"{path}: vertex id {i} out of [1, {n}] "
+                        "(DIMACS ids are 1-indexed)")
                 xs[idx] = int(x)
                 ys[idx] = int(y)
                 seen += 1

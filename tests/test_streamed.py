@@ -38,8 +38,14 @@ def test_streamed_matches_resident_free_flow(stream_setup):
     np.testing.assert_array_equal(f_s, f_r)
     stats = st.last_stats
     assert stats["n_queries"] == len(queries)
-    assert stats["row_chunks"] == -(-stats["distinct_targets"] // 37)
-    assert stats["bytes_streamed"] == stats["distinct_targets"] * g.n
+    if stats["mode"] == "compacted":
+        assert stats["row_chunks"] == -(-stats["distinct_targets"] // 37)
+    else:
+        # range chunks cover gaps too, so there are at least as many
+        assert stats["row_chunks"] >= -(-stats["distinct_targets"] // 37)
+    # both modes upload whole [C, N] chunks (range mode covers gap rows,
+    # compacted mode pads the tail chunk)
+    assert stats["bytes_streamed"] == stats["row_chunks"] * 37 * g.n
 
 
 def test_streamed_matches_resident_diffed(stream_setup):
@@ -69,3 +75,19 @@ def test_streamed_rejects_mismatched_controller(stream_setup):
     other = DistributionController("mod", 2, 2, g.n)
     with pytest.raises(ValueError, match="was built with"):
         StreamedCPDOracle(g, other, outdir)
+
+
+def test_streamed_modes_agree(stream_setup, monkeypatch):
+    """Range and compacted chunking must produce identical answers."""
+    g, dc, outdir, queries, resident = stream_setup
+    monkeypatch.setenv("DOS_STREAM_RANGE_DENSITY", "0.0")   # force range
+    st_r = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
+    c_r, p_r, f_r = st_r.query(queries)
+    assert st_r.last_stats["mode"] == "range"
+    monkeypatch.setenv("DOS_STREAM_RANGE_DENSITY", "2.0")   # force compact
+    st_c = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
+    c_c, p_c, f_c = st_c.query(queries)
+    assert st_c.last_stats["mode"] == "compacted"
+    np.testing.assert_array_equal(c_r, c_c)
+    np.testing.assert_array_equal(p_r, p_c)
+    np.testing.assert_array_equal(f_r, f_c)
