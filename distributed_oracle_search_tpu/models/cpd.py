@@ -75,6 +75,16 @@ def shard_block_name(wid: int, bid: int) -> str:
     return f"cpd-w{wid:05d}-b{bid:05d}.npy"
 
 
+def length_estimate(graph: Graph, s: np.ndarray, t: np.ndarray):
+    """Cheap host-side walk-length predictor: L1 coordinate distance
+    (road networks keep path length ~monotone in it). Zero device work;
+    used only to ORDER queries so the bucketed walk groups similar
+    lengths — never affects answers. Shared by the resident and streamed
+    serving paths."""
+    xs, ys = graph.xs, graph.ys
+    return np.abs(xs[s] - xs[t]) + np.abs(ys[s] - ys[t])
+
+
 #: shift coverage below which auto falls back to the ELL gather relaxation
 SHIFT_COVERAGE_MIN = 0.9
 
@@ -365,13 +375,7 @@ class CPDOracle:
 
     # ------------------------------------------------------------- query
     def _length_estimate(self, queries: np.ndarray) -> np.ndarray:
-        """Cheap host-side walk-length predictor: L1 coordinate distance
-        (road networks keep path length ~monotone in it). Zero device
-        work; used only to ORDER queries so the bucketed walk groups
-        similar lengths — never affects answers."""
-        xs, ys = self.graph.xs, self.graph.ys
-        s, t = queries[:, 0], queries[:, 1]
-        return np.abs(xs[s] - xs[t]) + np.abs(ys[s] - ys[t])
+        return length_estimate(self.graph, queries[:, 0], queries[:, 1])
 
     def route(self, queries: np.ndarray, active_worker: int = -1):
         """Pack (s, t) queries into mesh-shaped [D, W, Q] arrays.
@@ -457,15 +461,42 @@ class CPDOracle:
         return out_c, out_p, out_f
 
     # ------------------------------------------------- prepared tables
+    def table_memory_bytes(self) -> int:
+        """Device bytes the prepared tables will occupy: int32 cost +
+        sign-packed plen (int16 when N < 2^15) per (worker, row, node)."""
+        from ..ops.pointer_doubling import plen_dtype
+
+        w, r = self.targets_wr.shape
+        per_entry = 4 + jnp.dtype(plen_dtype(self.graph.n)).itemsize
+        return w * r * self.graph.n * per_entry
+
+    @property
+    def TABLE_BUDGET(self) -> int:
+        """Per-device budget for prepared tables (bytes). Read lazily so
+        DOS_TABLE_BUDGET_GB works as a runtime knob; malformed values
+        fall back to the default (8 GB — conservative v5e headroom next
+        to the resident fm + dists) instead of crashing."""
+        try:
+            gb = float(os.environ.get("DOS_TABLE_BUDGET_GB", "8"))
+        except ValueError:
+            gb = 8.0
+        return int((gb if gb > 0 else 8.0) * 1e9)
+
     def prepare_weights(self, w_query: np.ndarray | None = None,
                         max_len: int = 0, chunk: int = 2048):
-        """Pointer-doubling: precompute cost/plen/finished for EVERY
+        """Pointer-doubling: precompute cost + packed plen for EVERY
         (source, owned-target) pair under ``w_query`` in O(log L) sweeps
         (``ops.pointer_doubling``). After this, :meth:`query_table`
         answers any query on these weights with one gather — the
-        amortization path for huge campaigns (BASELINE.md's 10M-query
-        config), including congestion-diffed rounds where
-        :meth:`query_dist` does not apply.
+        amortization path for huge campaigns, including congestion-diffed
+        rounds where :meth:`query_dist` does not apply.
+
+        **Measured trade (BENCH_r03, 9216-node shard, v5e):** prepare
+        38.9 s, lookups ~515k q/s vs the ~200k q/s walk → break-even at
+        ~13M queries per diff round. Memory: 6-8 bytes/entry = 6-8x the
+        fm shard; calls whose tables exceed the per-device budget
+        (``DOS_TABLE_BUDGET_GB``, default 8) raise with the math instead
+        of faulting mid-campaign.
 
         ``chunk`` bounds the per-device rows doubled at once (several
         [rows, N] int32 live arrays per sweep; oversized batches fault).
@@ -474,6 +505,23 @@ class CPDOracle:
         """
         if self.fm is None:
             raise RuntimeError("build() or load() before prepare_weights()")
+        need = self.table_memory_bytes()
+        # tables shard over the WORKER axis only (build_tables_sharded
+        # out_specs) — they are REPLICATED across the data axis, so the
+        # per-device share divides by W, not by total device count
+        n_w = max(self.mesh.shape[WORKER_AXIS], 1)
+        budget = self.TABLE_BUDGET
+        if need / n_w > budget:
+            w, r = self.targets_wr.shape
+            raise ValueError(
+                f"prepared tables need {need / 1e9:.1f} GB "
+                f"({w}x{r}x{self.graph.n} entries x "
+                f"{need // (w * r * self.graph.n)} B, sharded over {n_w} "
+                f"worker shard(s) = {need / n_w / 1e9:.1f} GB/device) — "
+                f"over the {budget / 1e9:.1f} GB/device budget "
+                "(DOS_TABLE_BUDGET_GB). At this scale serve via the walk "
+                "or StreamedCPDOracle instead; the table trade only pays "
+                "past ~13M queries per diff round anyway.")
         w_pad = (self.dg.w_pad if w_query is None
                  else jnp.asarray(self.graph.padded_weights(w_query),
                                   jnp.int32))
@@ -496,8 +544,8 @@ class CPDOracle:
                      w_pad, self.mesh, max_len=max_len)
                  for i in range(0, tw.shape[1], chunk)]
         cat = lambda xs: jnp.concatenate(xs, axis=1)[:, :r]  # noqa: E731
-        c, p, f = zip(*parts)
-        return cat(c), cat(p), cat(f)
+        c, p = zip(*parts)
+        return cat(c), cat(p)
 
     def query_table(self, tables, queries: np.ndarray,
                     active_worker: int = -1):

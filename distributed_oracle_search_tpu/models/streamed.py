@@ -36,7 +36,7 @@ from ..data.graph import Graph
 from ..ops import DeviceGraph
 from ..ops.table_search import table_search_batch
 from ..parallel.partition import DistributionController
-from .cpd import shard_block_name, validate_manifest
+from .cpd import length_estimate, shard_block_name, validate_manifest
 
 
 def _pow2(x: int) -> int:
@@ -194,7 +194,6 @@ class StreamedCPDOracle:
             bounds = np.searchsorted(
                 q_chunk[q_by_chunk], np.arange(n_chunks + 1))
             qp_all = _pow2(int(np.diff(bounds).max()))
-        xs, ys = self.graph.xs, self.graph.ys
 
         def prep(ci):
             """Host read + padding + device upload (async enqueue) for
@@ -214,8 +213,7 @@ class StreamedCPDOracle:
             q_idx = q_by_chunk[lo:hi]
             # order by expected walk length so the kernel's bucketed
             # while_loops exit early (same trick as CPDOracle.route)
-            est = (np.abs(xs[s_all[q_idx]] - xs[t_all[q_idx]])
-                   + np.abs(ys[s_all[q_idx]] - ys[t_all[q_idx]]))
+            est = length_estimate(self.graph, s_all[q_idx], t_all[q_idx])
             q_idx = q_idx[np.argsort(est, kind="stable")]
             rows_l = np.zeros(qp_all, np.int32)
             s_l = np.zeros(qp_all, np.int32)

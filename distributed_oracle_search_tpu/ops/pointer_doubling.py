@@ -17,10 +17,16 @@ converges in ceil(log2 L) sweeps to the TOTAL cost from every node to
 every owned target — after which any (s, t) query is ONE gather, on diffed
 weights too (the walk's only advantage was laziness).
 
-Cost model (bench graph, v5e): one sweep gathers 2·R·N elements; log2(L)≈8
-sweeps ≈ a few seconds — worth it when a diff round answers more than
-roughly ``R·N·log2(L) / L`` queries (~1M on the bench shapes; the DIMACS
-10M-query campaign in BASELINE.md §configs[4] is the target workload).
+Cost model — MEASURED, not aspirational (bench graph 9216x9216, v5e,
+BENCH_r03): one sweep is 3 dependent ``[R, N]`` gathers; ~8 sweeps at the
+device's ~100 M dependent-gathers/s = **38.9 s** prepare for the full
+shard, then lookups at ~515k q/s vs the ~200k q/s walk. Break-even on
+those numbers: a diff round must answer ~**13M queries**
+(``prepare / (1/walk_qps − 1/lookup_qps)``) before the tables pay for
+themselves — the regime of BASELINE.md configs[4]'s 10M-query DIMACS
+campaign, not of small scenarios. Memory: cost int32 + sign-packed plen
+(int16 when ``N < 32768``) = 6-8 bytes per entry = **6-8x the fm shard**;
+``models.cpd.prepare_weights`` enforces a budget gate before allocating.
 Self-loops make the recursion total: the target itself and stuck
 (unreachable) nodes point at themselves with step cost 0, so their
 accumulated cost is exactly the walk's cost-until-stuck.
@@ -36,10 +42,16 @@ import jax.numpy as jnp
 from .device_graph import DeviceGraph
 
 
+def plen_dtype(n: int):
+    """Packed-plen dtype: int16 when every path length (< N) fits with
+    the sign bit spare, else int32."""
+    return jnp.int16 if n < (1 << 15) else jnp.int32
+
+
 @functools.partial(jax.jit, static_argnames=("max_len",))
 def doubled_tables(dg: DeviceGraph, fm: jnp.ndarray, targets: jnp.ndarray,
                    w_query_pad: jnp.ndarray, max_len: int = 0):
-    """All-source cost/plen/finished tables for one fm shard.
+    """All-source cost + packed-plen tables for one fm shard.
 
     Parameters
     ----------
@@ -50,8 +62,12 @@ def doubled_tables(dg: DeviceGraph, fm: jnp.ndarray, targets: jnp.ndarray,
 
     Returns
     -------
-    cost [R, N] int32, plen [R, N] int32, finished [R, N] bool
-    (rows with targets[r] < 0 are all-unfinished padding)
+    cost [R, N] int32, plen_packed [R, N] (:func:`plen_dtype`):
+    ``finished`` rides plen's sign — finished entries store ``plen``,
+    unfinished store ``-plen - 1`` (decode via :func:`lookup_tables`).
+    Rows with ``targets[r] < 0`` are all-unfinished padding. Dropping the
+    separate finished tensor and narrowing plen cuts the table from 12 to
+    6-8 bytes per entry.
     """
     r, n = fm.shape
     limit = n if max_len == 0 else max_len
@@ -94,19 +110,33 @@ def doubled_tables(dg: DeviceGraph, fm: jnp.ndarray, targets: jnp.ndarray,
     t_safe = jnp.where(valid, targets, 0).astype(jnp.int32)
     finished = (succ == t_safe[:, None]) & valid[:, None]
     del rows
-    return cost, plen, finished
+    plen_packed = jnp.where(finished, plen, -plen - 1).astype(plen_dtype(n))
+    return cost, plen_packed
+
+
+def unpack_tables(cost, plen_packed):
+    """Whole-table decode (cost, plen, finished) — for tests and direct
+    table consumers; serving uses :func:`lookup_tables` per query."""
+    pp = plen_packed.astype(jnp.int32)
+    f = pp >= 0
+    return cost, jnp.where(f, pp, -pp - 1), f
 
 
 @jax.jit
-def lookup_tables(cost: jnp.ndarray, plen: jnp.ndarray,
-                  finished: jnp.ndarray, t_rows: jnp.ndarray,
-                  s: jnp.ndarray, valid: jnp.ndarray | None = None):
-    """Answer queries from prepared tables: one 2-D gather each."""
+def lookup_tables(cost: jnp.ndarray, plen_packed: jnp.ndarray,
+                  t_rows: jnp.ndarray, s: jnp.ndarray,
+                  valid: jnp.ndarray | None = None):
+    """Answer queries from prepared tables: one 2-D gather each.
+
+    Decodes the sign-packed plen: ``finished = packed >= 0``,
+    ``plen = packed`` when finished else ``-packed - 1``.
+    """
     rows = t_rows.astype(jnp.int32)
     s32 = s.astype(jnp.int32)
     c = cost[rows, s32]
-    p = plen[rows, s32]
-    f = finished[rows, s32]
+    pp = plen_packed[rows, s32].astype(jnp.int32)
+    f = pp >= 0
+    p = jnp.where(f, pp, -pp - 1)
     if valid is not None:
         c = jnp.where(valid, c, 0)
         p = jnp.where(valid, p, 0)

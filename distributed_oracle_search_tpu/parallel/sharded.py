@@ -187,17 +187,15 @@ def _tables_fn(mesh: Mesh, max_len: int):
         _local, mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS, None, None), P(WORKER_AXIS, None),
                   P()),
-        out_specs=(P(WORKER_AXIS, None), P(WORKER_AXIS, None),
-                   P(WORKER_AXIS, None)),
+        out_specs=(P(WORKER_AXIS, None), P(WORKER_AXIS, None)),
     )
 
     def _wrap(dg, fm_wrn, tgt_wr, w_pad):
-        c, p, f = sm(dg, fm_wrn, tgt_wr, w_pad)
+        c, p = sm(dg, fm_wrn, tgt_wr, w_pad)
         # shard_map emits [W*R, N] (axis-0 concat of local [R, N]); restore
         # the worker axis
         w = fm_wrn.shape[0]
-        return (c.reshape(w, -1, dg.n), p.reshape(w, -1, dg.n),
-                f.reshape(w, -1, dg.n))
+        return c.reshape(w, -1, dg.n), p.reshape(w, -1, dg.n)
 
     return jax.jit(_wrap)
 
@@ -220,26 +218,26 @@ def _query_table_fn(mesh: Mesh):
 
     q3 = P(DATA_AXIS, WORKER_AXIS, None)
 
-    def _local(cost, plen, fin, rows, s, valid):
+    def _local(cost, plen_packed, rows, s, valid):
         shape = s.shape
-        c, p, f = lookup_tables(cost[0], plen[0], fin[0],
+        c, p, f = lookup_tables(cost[0], plen_packed[0],
                                 rows.reshape(-1), s.reshape(-1),
                                 valid.reshape(-1))
         return c.reshape(shape), p.reshape(shape), f.reshape(shape)
 
     t3 = P(WORKER_AXIS, None, None)
     sm = jax.shard_map(_local, mesh=mesh,
-                       in_specs=(t3, t3, t3, q3, q3, q3),
+                       in_specs=(t3, t3, q3, q3, q3),
                        out_specs=(q3, q3, q3))
     return jax.jit(sm)
 
 
 def query_tables_sharded(tables, t_rows, s, valid, mesh: Mesh):
     """Answer routed [D, W, Q] queries from prepared cost tables."""
-    cost, plen, fin = tables
+    cost, plen_packed = tables
     qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
     rows_d, s_d, v_d = jax.device_put((t_rows, s, valid), qs)
-    return _query_table_fn(mesh)(cost, plen, fin, rows_d, s_d, v_d)
+    return _query_table_fn(mesh)(cost, plen_packed, rows_d, s_d, v_d)
 
 
 # --------------------------------------------------------------------- paths

@@ -16,6 +16,9 @@ from distributed_oracle_search_tpu.models.cpd import CPDOracle
 from distributed_oracle_search_tpu.ops import (
     DeviceGraph, doubled_tables, extract_paths, lookup_tables,
 )
+from distributed_oracle_search_tpu.ops.pointer_doubling import (
+    unpack_tables,
+)
 from distributed_oracle_search_tpu.parallel import DistributionController
 from distributed_oracle_search_tpu.parallel.mesh import make_mesh
 
@@ -33,8 +36,9 @@ def setup(toy_graph):
 def test_doubled_tables_match_walk_free_flow(setup):
     g, fm, dg = setup
     targets = jnp.arange(g.n, dtype=jnp.int32)
-    c, p, f = doubled_tables(dg, jnp.asarray(fm), targets,
-                             jnp.asarray(g.padded_weights(), jnp.int32))
+    c, p, f = unpack_tables(*doubled_tables(
+        dg, jnp.asarray(fm), targets,
+        jnp.asarray(g.padded_weights(), jnp.int32)))
     c, p, f = map(np.asarray, (c, p, f))
     fm_of = lambda x, t: fm[t, x]  # noqa: E731
     for t in range(0, g.n, 7):
@@ -47,8 +51,9 @@ def test_doubled_tables_match_walk_diffed(setup):
     g, fm, dg = setup
     w = g.weights_with_diff(synth_diff(g, frac=0.3, seed=9))
     targets = jnp.arange(g.n, dtype=jnp.int32)
-    c, p, f = doubled_tables(dg, jnp.asarray(fm), targets,
-                             jnp.asarray(g.padded_weights(w), jnp.int32))
+    c, p, f = unpack_tables(*doubled_tables(
+        dg, jnp.asarray(fm), targets,
+        jnp.asarray(g.padded_weights(w), jnp.int32)))
     c = np.asarray(c)
     fm_of = lambda x, t: fm[t, x]  # noqa: E731
     for t in range(0, g.n, 6):
@@ -60,8 +65,9 @@ def test_doubled_tables_match_walk_diffed(setup):
 def test_doubled_tables_padding_rows(setup):
     g, fm, dg = setup
     targets = jnp.asarray([0, -1, 2], jnp.int32)
-    c, p, f = doubled_tables(dg, jnp.asarray(fm[[0, 0, 2]]), targets,
-                             jnp.asarray(g.padded_weights(), jnp.int32))
+    c, p, f = unpack_tables(*doubled_tables(
+        dg, jnp.asarray(fm[[0, 0, 2]]), targets,
+        jnp.asarray(g.padded_weights(), jnp.int32)))
     assert not np.asarray(f)[1].any()  # padding row unfinished
 
 
@@ -110,3 +116,13 @@ def test_extract_paths_match_cpu_walk(setup):
         assert list(nodes[q][:wp + 1]) == path[:wp + 1]
         # after the walk ends, the last node repeats
         assert (nodes[q][wp:] == nodes[q][wp]).all()
+
+
+def test_prepare_weights_budget_gate(toy_graph, monkeypatch):
+    """Oversized table requests must refuse with the math, not fault."""
+    dc = DistributionController("tpu", None, 4, toy_graph.n)
+    oracle = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=4)).build()
+    monkeypatch.setattr(CPDOracle, "TABLE_BUDGET", 10)   # 10 bytes
+    with pytest.raises(ValueError, match="GB/device budget"):
+        oracle.prepare_weights()
+    assert oracle.table_memory_bytes() > 10
