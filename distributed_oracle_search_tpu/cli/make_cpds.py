@@ -76,13 +76,13 @@ def run_tpu(conf: ClusterConfig, args) -> None:
 
     from ..data.graph import Graph
     from ..models.cpd import CPDOracle
-    from ..parallel.mesh import make_mesh
+    from ..parallel.mesh import mesh_from_config
     from ..parallel.partition import DistributionController
 
     graph = Graph.from_xy(conf.xy_file)
     dc = DistributionController(conf.partmethod, conf.partkey,
                                 conf.maxworker, graph.n)
-    mesh = make_mesh(n_workers=conf.maxworker)
+    mesh = mesh_from_config(conf)
     oracle = CPDOracle(graph, dc, mesh=mesh)
     oracle.build(chunk=args.chunk)
     oracle.save(conf.outdir)

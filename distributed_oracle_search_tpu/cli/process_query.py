@@ -93,10 +93,10 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
     """
     from ..data.graph import Graph
     from ..models.cpd import CPDOracle
-    from ..parallel.mesh import make_mesh
+    from ..parallel.mesh import mesh_from_config
 
     graph = Graph.from_xy(conf.xy_file)
-    mesh = make_mesh(n_workers=conf.maxworker)
+    mesh = mesh_from_config(conf)
     oracle = CPDOracle(graph, dc, mesh=mesh)
     try:
         oracle.load(conf.outdir)

@@ -330,28 +330,38 @@ class CPDOracle:
     def save(self, outdir: str) -> None:
         """Write the CPD index: one .npy per (worker, block) + manifest.
 
-        Multi-controller safe: with >1 JAX process the sharded table is
-        allgathered (its shards live on non-addressable devices) and only
-        process 0 writes, so concurrent controllers never race on the
+        Multi-controller safe: with >1 JAX process each (worker, block)
+        slice is allgathered SEPARATELY (its shards live on
+        non-addressable devices) and only process 0 writes — no host
+        ever materializes the full ``[W, R, N]`` table (at the README's
+        NY scale that would be 70 GB of RAM per controller just to let
+        process 0 write), and concurrent controllers never race on the
         shared index directory."""
         if self.fm is None:
             raise RuntimeError("build() or load() before save()")
-        fm = _host(self.fm)
-        if jax.process_count() > 1:
+        multi = jax.process_count() > 1
+        if multi:
             from ..parallel.multihost import is_primary
-
-            if not is_primary():
-                return
-        os.makedirs(outdir, exist_ok=True)
+            primary = is_primary()
+        else:
+            primary = True
+        if primary:
+            os.makedirs(outdir, exist_ok=True)
         bs = self.dc.block_size
         for wid in range(self.dc.maxworker):
             n_owned = self.dc.n_owned(wid)
             for b0 in range(0, n_owned, bs):
-                rows = fm[wid, b0:min(b0 + bs, n_owned)]
-                np.save(os.path.join(
-                    outdir, shard_block_name(wid, b0 // bs)), rows)
-        write_index_manifest(outdir, self.dc,
-                             rows_per_worker=int(self.targets_wr.shape[1]))
+                hi = min(b0 + bs, n_owned)
+                # every process participates in the gather (collective);
+                # only the primary touches the filesystem
+                rows = _host(self.fm[wid, b0:hi])
+                if primary:
+                    np.save(os.path.join(
+                        outdir, shard_block_name(wid, b0 // bs)), rows)
+        if primary:
+            write_index_manifest(
+                outdir, self.dc,
+                rows_per_worker=int(self.targets_wr.shape[1]))
 
     def load(self, outdir: str) -> "CPDOracle":
         """Load a saved index onto the mesh, validating partition consistency

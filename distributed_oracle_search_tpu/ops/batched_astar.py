@@ -103,8 +103,11 @@ def astar_batch(in_nbr: jnp.ndarray, in_eid: jnp.ndarray,
     dx = xs[:, None] - xs[t][None, :]
     dy = ys[:, None] - ys[t][None, :]
     h_raw = jnp.sqrt(dx * dx + dy * dy) * cpu * hscale
+    # clamp below int32 range: a saturating float->int convert is
+    # backend-dependent, and a wrapped h would corrupt the prune compare
     h = jnp.maximum(
-        jnp.floor(h_raw * (1.0 - 4e-7) - 1.0), 0.0).astype(jnp.int32)
+        jnp.minimum(jnp.floor(h_raw * (1.0 - 4e-7) - 1.0), 2.0e9),
+        0.0).astype(jnp.int32)
 
     g0 = jnp.full((n, q), JINF, jnp.int32).at[s, qix].min(
         jnp.where(valid, jnp.int32(0), JINF))
@@ -125,7 +128,10 @@ def astar_batch(in_nbr: jnp.ndarray, in_eid: jnp.ndarray,
         thr = jnp.where(fscale > 0,
                         (1.0 + fscale) * ub.astype(jnp.float32),
                         ub.astype(jnp.float32))
-        pruned = (g + h).astype(jnp.float32) > thr[None, :]
+        # compare in float32: g + h as int32 could wrap when g is JINF
+        # and h large (hscale-inflated), flipping the prune decision
+        pruned = (g.astype(jnp.float32)
+                  + h.astype(jnp.float32)) > thr[None, :]
         prop = jnp.where(pruned, JINF, g)               # pruned don't push
         via = jnp.minimum(w_in[:, :, None] + prop[in_nbr, :], JINF)
         best = via.min(axis=1)                          # [N, Q]
@@ -212,7 +218,12 @@ def astar_batch_np(graph, queries: np.ndarray, w: np.ndarray | None = None,
     totals = dict(n_expanded=0, n_surplus=0, n_touched=0, n_inserted=0,
                   n_updated=0)
     for lo in range(0, nq, chunk):
-        if deadline is not None and _time.perf_counter() > deadline:
+        # always attempt the FIRST chunk: an already-expired budget must
+        # still produce a minimal answer, matching the per-query CPU
+        # oracle's at-least-one-query behavior (the engine checks its
+        # deadline after work, not before)
+        if lo > 0 and deadline is not None \
+                and _time.perf_counter() > deadline:
             break
         part = queries[lo:lo + chunk]
         m = len(part)

@@ -39,6 +39,35 @@ def make_mesh(n_workers: int | None = None, n_data: int = 1,
     return Mesh(dev, (DATA_AXIS, WORKER_AXIS))
 
 
+def mesh_from_config(conf) -> Mesh:
+    """Build the campaign mesh from a :class:`~..utils.config.ClusterConfig`.
+
+    ``mesh_shape``/``mesh_axes`` (optional config keys) pin the exact
+    layout — e.g. ``[2, 4]`` with ``["data", "worker"]`` — with the
+    worker axis required to equal ``maxworker`` (one shard per worker,
+    the partmethod=tpu invariant). Absent, the default is
+    ``(1, maxworker)``.
+    """
+    if conf.mesh_shape is None:
+        return make_mesh(n_workers=conf.maxworker)
+    axes = (list(conf.mesh_axes) if conf.mesh_axes is not None
+            else [DATA_AXIS, WORKER_AXIS][-len(conf.mesh_shape):])
+    if sorted(axes) != sorted([DATA_AXIS, WORKER_AXIS])[:len(axes)] and \
+            axes != [WORKER_AXIS]:
+        raise ValueError(
+            f"mesh_axes must be drawn from "
+            f"['{DATA_AXIS}', '{WORKER_AXIS}'], got {axes}")
+    shape = dict(zip(axes, conf.mesh_shape))
+    n_workers = shape.get(WORKER_AXIS, conf.maxworker)
+    if n_workers != conf.maxworker:
+        raise ValueError(
+            f"mesh_shape worker axis {n_workers} != maxworker "
+            f"{conf.maxworker}; partmethod=tpu requires one mesh shard "
+            "per worker")
+    return make_mesh(n_workers=n_workers,
+                     n_data=shape.get(DATA_AXIS, 1))
+
+
 def worker_sharding(mesh: Mesh, rank: int = 1) -> NamedSharding:
     """Shard axis 0 over workers, replicate everything else (CPD layout)."""
     return NamedSharding(mesh, P(WORKER_AXIS, *([None] * (rank - 1))))

@@ -102,6 +102,20 @@ def is_primary() -> bool:
         import jax
 
         return jax.process_index() == 0
+    # the JAX distributed runtime may have been initialized OUTSIDE this
+    # wrapper (direct jax.distributed.initialize, TPU pod auto-init) —
+    # every controller reporting primary would then write shared
+    # artifacts concurrently. Consult the process index iff the backend
+    # client already exists, WITHOUT triggering backend init ourselves.
+    try:
+        from jax._src import distributed as _jdist
+
+        if _jdist.global_state.client is not None:
+            import jax
+
+            return jax.process_index() == 0
+    except (ImportError, AttributeError):  # private API moved: assume
+        pass                               # single-controller
     return True
 
 

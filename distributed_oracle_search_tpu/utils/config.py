@@ -22,7 +22,9 @@ Schema parity with the reference cluster-conf JSON
 New (this framework): ``partmethod: "tpu"`` routes partitions onto a
 ``jax.sharding.Mesh`` in-process instead of onto ssh hostnames — the north-star
 design from BASELINE.json. ``mesh_shape``/``mesh_axes`` optionally pin the mesh
-layout; by default a 1-D ``("worker",)`` mesh of ``len(workers)`` devices.
+layout (e.g. ``[2, 4]`` with ``["data", "worker"]`` — consumed by
+``parallel.mesh.mesh_from_config``, which every TPU-mode entry point uses);
+by default a ``(1, maxworker)`` mesh.
 """
 
 from __future__ import annotations

@@ -43,9 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chunk", type=int, default=0,
                    help="build-step rows (0 = whole shard at once)")
     p.add_argument("--method", default="auto",
-                   choices=["auto", "shift", "ell"],
-                   help="relaxation kernel: gather-free shift path, "
-                        "padded-ELL gather, or auto by shift coverage")
+                   choices=["auto", "sweep", "shift", "ell"],
+                   help="relaxation kernel: fast-sweeping grid scans, "
+                        "gather-free shift path, padded-ELL gather, or "
+                        "auto by structure gates (models.cpd."
+                        "pick_build_kernel)")
     p.add_argument("--no-resume", action="store_true",
                    help="rebuild blocks even if their files exist")
     p.add_argument("-v", "--verbose", action="count", default=0)

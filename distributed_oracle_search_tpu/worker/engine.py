@@ -60,6 +60,9 @@ class ShardEngine:
         self.graph = graph
         self.dc = dc
         self.wid = wid
+        #: device-batch rows per A* chunk; the deadline is checked
+        #: between chunks (first chunk always runs)
+        self.astar_chunk = 1024
         if alg == "table-search":  # astar needs no first-move shard
             self.fm = jnp.asarray(load_shard_rows(outdir, wid))
             owned = dc.owned(wid)
@@ -205,9 +208,9 @@ class ShardEngine:
         """hscale/fscale weighted A* — the serving path is the **batched
         device kernel** (``ops.batched_astar``): the whole batch searches
         in lock-step sweeps, chunked to bound the working set, with the
-        ``time`` deadline checked between chunks (remaining chunks stay
-        unfinished — real partial-result semantics, unlike the old
-        between-iterations check). ``config.debug`` instead runs the
+        ``time`` deadline checked between chunks — the FIRST chunk always
+        runs (an expired budget still yields a minimal answer, like the
+        per-query CPU oracle), remaining chunks stay unfinished. ``config.debug`` instead runs the
         per-query CPU heap oracle (``models.astar``) — the deterministic,
         expansion-order-faithful repro path, matching the reference's
         debug mode forcing single-threaded runs (reference
@@ -231,7 +234,7 @@ class ShardEngine:
             cost, plen, fin, counters = astar_batch_np(
                 self.graph, queries, w, hscale=config.hscale,
                 fscale=config.fscale, deadline=deadline, cpu=cpu,
-                ctx=self._astar_ctx,
+                chunk=self.astar_chunk, ctx=self._astar_ctx,
                 w_key=None if config.no_cache else difffile)
             counters["plen"] = int(plen.sum())
             counters["finished"] = int(fin.sum())

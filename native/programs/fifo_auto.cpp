@@ -183,6 +183,18 @@ struct Server {
         auto cfg = flatjson::parse(cfg_json);
         int64_t k_moves = int64_t(flatjson::get(cfg, "k_moves", -1));
         int threads = int(flatjson::get(cfg, "threads", 0));
+        if (flatjson::get(cfg, "thread_alloc", 0) != 0) {
+            // receiver-thread pinning (reference args.py:164-169) has no
+            // analog in this engine's batch model; say so rather than
+            // silently ignoring the knob
+            static bool warned = false;
+            if (!warned) {
+                std::fprintf(stderr,
+                             "fifo_auto: thread_alloc is not supported "
+                             "by this engine (ignored)\n");
+                warned = true;
+            }
+        }
         bool no_cache = flatjson::get(cfg, "no_cache", 0) != 0;
         int64_t itrs =
             std::max<int64_t>(1, int64_t(flatjson::get(cfg, "itrs", 1)));

@@ -188,3 +188,28 @@ def test_build_program_has_no_collectives(toy_graph):
     for op in ("all-reduce", "all-gather", "collective-permute",
                "all-to-all", "reduce-scatter"):
         assert op not in hlo, f"build program contains a {op} collective"
+
+
+def test_mesh_from_config(toy_graph):
+    """mesh_shape/mesh_axes config keys drive the campaign mesh; the
+    worker axis must match maxworker (one shard per worker)."""
+    from distributed_oracle_search_tpu.parallel.mesh import (
+        DATA_AXIS, mesh_from_config,
+    )
+    from distributed_oracle_search_tpu.utils.config import ClusterConfig
+
+    base = dict(workers=["localhost"] * 4, partmethod="tpu", partkey=0,
+                outdir="x", xy_file="x.xy", scenfile="x.scen")
+    conf = ClusterConfig(**base)
+    m = mesh_from_config(conf)
+    assert m.shape[WORKER_AXIS] == 4 and m.shape[DATA_AXIS] == 1
+
+    conf = ClusterConfig(**base, mesh_shape=[2, 4],
+                         mesh_axes=["data", "worker"])
+    m = mesh_from_config(conf)
+    assert m.shape[DATA_AXIS] == 2 and m.shape[WORKER_AXIS] == 4
+
+    conf = ClusterConfig(**base, mesh_shape=[2, 2],
+                         mesh_axes=["data", "worker"])
+    with pytest.raises(ValueError, match="maxworker"):
+        mesh_from_config(conf)
