@@ -124,11 +124,14 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                       help="Disable the workers' runtime cache.")
     fifo.add_argument("--alg", default="table-search",
                       choices=["table-search", "astar", "ch"],
-                      help="Serving algorithm for launched servers "
-                           "(make_fifos). The reference hard-codes "
-                           "table-search (make_fifos.py:20); astar serves "
-                           "the hscale/fscale family, ch the "
-                           "congestion-free contraction hierarchy.")
+                      help="Serving algorithm — honored by BOTH backends "
+                           "(host servers via make_fifos, and the "
+                           "in-process TPU campaign). The reference "
+                           "hard-codes table-search (make_fifos.py:20); "
+                           "astar serves the hscale/fscale family "
+                           "(batched device kernel in TPU mode), ch the "
+                           "congestion-free contraction hierarchy "
+                           "(native engine only).")
 
     new = p.add_argument_group("tpu (new in this framework)")
     new.add_argument("--backend", choices=["auto", "tpu", "host"],

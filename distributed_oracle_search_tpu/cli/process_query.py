@@ -95,29 +95,63 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
     from ..models.cpd import CPDOracle
     from ..parallel.mesh import mesh_from_config
 
+    alg = getattr(args, "alg", "table-search")
+    if alg == "ch":
+        raise SystemExit(
+            "--alg ch is served by the native engine only "
+            "(--backend host with make_fifos --engine native); the "
+            "hierarchy is a pointer-chasing CPU structure with no "
+            "device analog here")
+
     graph = Graph.from_xy(conf.xy_file)
-    mesh = mesh_from_config(conf)
-    oracle = CPDOracle(graph, dc, mesh=mesh)
-    try:
-        oracle.load(conf.outdir)
-    except FileNotFoundError:
-        log.info("no index at %s; building in-process", conf.outdir)
-        oracle.build(chunk=args.chunk)
-        oracle.save(conf.outdir)
+    use_astar = alg == "astar"
+    if use_astar:
+        # A* searches the graph directly — no CPD index involved
+        from ..ops.batched_astar import astar_batch_np
+
+        astar_ctx: dict = {}
+        oracle = None
+    else:
+        mesh = mesh_from_config(conf)
+        oracle = CPDOracle(graph, dc, mesh=mesh)
+        try:
+            oracle.load(conf.outdir)
+        except FileNotFoundError:
+            log.info("no index at %s; building in-process", conf.outdir)
+            oracle.build(chunk=args.chunk)
+            oracle.save(conf.outdir)
 
     owner = dc.worker_of(queries[:, 1])
+    time_ns = get_time_ns(args)
     stats = []
     paths = None
     for diff in diffs:
+        counters = {}
+        active = (np.ones(len(queries), bool) if args.worker == -1
+                  else owner == args.worker)
         with Timer() as prep:
             w_query = (None if diff == "-"
                        else graph.weights_with_diff(read_diff(diff)))
-        with Timer() as search:
-            cost, plen, fin = oracle.query(
-                queries, w_query=w_query, k_moves=args.k_moves,
-                active_worker=args.worker)
-        active = (np.ones(len(queries), bool) if args.worker == -1
-                  else owner == args.worker)
+        if use_astar:
+            import time as _time
+
+            deadline = (_time.perf_counter() + time_ns / 1e9
+                        if time_ns else None)
+            with Timer() as search:
+                cost = np.zeros(len(queries), np.int64)
+                plen = np.zeros(len(queries), np.int64)
+                fin = np.zeros(len(queries), bool)
+                c, p, f, counters = astar_batch_np(
+                    graph, queries[active], w=w_query,
+                    hscale=args.h_scale, fscale=args.f_scale,
+                    deadline=deadline, ctx=astar_ctx,
+                    w_key=diff if not args.no_cache else None)
+                cost[active], plen[active], fin[active] = c, p, f
+        else:
+            with Timer() as search:
+                cost, plen, fin = oracle.query(
+                    queries, w_query=w_query, k_moves=args.k_moves,
+                    active_worker=args.worker)
         total_moves = int(plen[active].sum())
         total_size = int(active.sum())
         rows = []
@@ -131,9 +165,17 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
             moves = int(plen[mask].sum())
             share = (moves / total_moves if total_moves
                      else size / max(total_size, 1))
+            # A* emits the full priority-queue telemetry, apportioned by
+            # the same share rule as the timers (one fused batch has no
+            # per-worker counters); table-search keeps its walk counters
             row = StatsRow(
-                n_expanded=moves,
-                n_touched=size,
+                n_expanded=(int(counters.get("n_expanded", 0) * share)
+                            if use_astar else moves),
+                n_inserted=int(counters.get("n_inserted", 0) * share),
+                n_touched=(int(counters.get("n_touched", 0) * share)
+                           if use_astar else size),
+                n_updated=int(counters.get("n_updated", 0) * share),
+                n_surplus=int(counters.get("n_surplus", 0) * share),
                 plen=moves,
                 finished=int(fin[mask].sum()),
                 t_receive=prep.interval * share,
@@ -144,12 +186,19 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
                                     t_partition=0.0, size=size))
         stats.append(rows)
     if getattr(args, "extract", False) and args.k_moves > 0:
-        # moves always follow the FREE-FLOW first-move table (reference
-        # semantics), so path prefixes are diff-invariant: extract once
-        nodes, moves = oracle.query_paths(queries, k=args.k_moves,
-                                          active_worker=args.worker)
-        paths = np.concatenate(
-            [queries, moves[:, None], nodes], axis=1)
+        if use_astar:
+            # reference semantics: "K-moves are only available with
+            # extractions while hScale only influences A*" (args.py:28)
+            log.warning("--extract is a table-search feature; ignored "
+                        "for --alg astar")
+        else:
+            # moves always follow the FREE-FLOW first-move table
+            # (reference semantics), so path prefixes are diff-invariant:
+            # extract once
+            nodes, moves = oracle.query_paths(queries, k=args.k_moves,
+                                              active_worker=args.worker)
+            paths = np.concatenate(
+                [queries, moves[:, None], nodes], axis=1)
     return stats, paths
 
 

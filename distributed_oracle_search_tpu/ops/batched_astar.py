@@ -125,13 +125,20 @@ def astar_batch(in_nbr: jnp.ndarray, in_eid: jnp.ndarray,
     def body(state):
         i, g, hops, changed, (n_exp, n_sur, n_tou, n_ins, n_upd) = state
         ub = g[t, qix]                                  # incumbent per query
-        thr = jnp.where(fscale > 0,
-                        (1.0 + fscale) * ub.astype(jnp.float32),
-                        ub.astype(jnp.float32))
-        # compare in float32: g + h as int32 could wrap when g is JINF
-        # and h large (hscale-inflated), flipping the prune decision
-        pruned = (g.astype(jnp.float32)
-                  + h.astype(jnp.float32)) > thr[None, :]
+        # integer threshold, EXACT at fscale=0 (a float32 compare at
+        # ~1e9 magnitudes rounds by up to 64 and could over-prune an
+        # optimal-path node, silently breaking hscale<=1 optimality);
+        # the fscale>0 threshold is a heuristic bound, so its float
+        # rounding is harmless — clamped to JINF to stay in int32
+        thr = jnp.where(
+            fscale > 0,
+            jnp.minimum(jnp.floor((1.0 + fscale)
+                                  * ub.astype(jnp.float32)),
+                        jnp.float32(JINF)).astype(jnp.int32),
+            ub)
+        # rearranged compare g > thr - h: exact int32 arithmetic with no
+        # wrap (g, thr <= JINF ~1e9; h <= 2e9 keeps thr - h > -2^31)
+        pruned = g > (thr[None, :] - h)
         prop = jnp.where(pruned, JINF, g)               # pruned don't push
         via = jnp.minimum(w_in[:, :, None] + prop[in_nbr, :], JINF)
         best = via.min(axis=1)                          # [N, Q]

@@ -52,6 +52,10 @@ def mesh_from_config(conf) -> Mesh:
         return make_mesh(n_workers=conf.maxworker)
     axes = (list(conf.mesh_axes) if conf.mesh_axes is not None
             else [DATA_AXIS, WORKER_AXIS][-len(conf.mesh_shape):])
+    if len(axes) != len(conf.mesh_shape):
+        raise ValueError(
+            f"mesh_axes {axes} and mesh_shape {list(conf.mesh_shape)} "
+            "must have the same length")
     if sorted(axes) != sorted([DATA_AXIS, WORKER_AXIS])[:len(axes)] and \
             axes != [WORKER_AXIS]:
         raise ValueError(

@@ -483,3 +483,31 @@ def test_python_server_back_to_back_writers(host_conf, built_index,
     finally:
         stop_server(fifo)
         th.join(timeout=10)
+
+
+def test_tpu_campaign_astar(dataset, tmp_path):
+    """TPU-mode --alg astar mirrors test_fifo_auto_astar: the batched
+    device A* serves the campaign with full priority-queue telemetry and
+    optimal costs at hscale=1 (the two backends really are
+    interchangeable per algorithm family)."""
+    datadir, paths = dataset
+    conf = ClusterConfig(
+        workers=[f"tpu:{i}" for i in range(8)],
+        partmethod="tpu", partkey=8,
+        outdir=str(tmp_path / "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=["-", paths["diff"]],
+    ).validate()
+    args = parse_args(["--alg", "astar"])
+    data, stats, _paths = pq.run(conf, args)
+    queries = read_scen(conf.scenfile)
+    for expe in stats:
+        assert sum(row[-1] for row in expe) == len(queries)
+        assert sum(row[6] for row in expe) == len(queries)   # finished
+        # telemetry columns carry the search counters
+        assert sum(row[0] for row in expe) > 0               # n_expanded
+        assert sum(row[1] for row in expe) > 0               # n_inserted
+        assert len(expe[0]) == len(STATS_HEADER) - 1
+    # ch is native-only; TPU mode must say so loudly
+    with pytest.raises(SystemExit, match="native"):
+        pq.run(conf, parse_args(["--alg", "ch", "--backend", "tpu"]))
