@@ -629,29 +629,52 @@ def main() -> None:
             g3 = g3.reorder(g3.rcm_order())
         _, ws_rcm, _, wl_rcm = g3.shift_split()
         cov_rcm = split_coverage(ws_rcm, wl_rcm)
-        kind3, _ = pick_build_kernel(g3, "auto")
+        kind3, st3k = pick_build_kernel(g3, "auto")
         log(f"road: n={g3.n} m={g3.m} K={g3.max_out_degree}; rcm reorder "
             f"{t_rcm}; shift coverage {cov_raw:.1%} -> {cov_rcm:.1%}; "
-            f"auto build kernel = {kind3} (gates fell back as designed)")
+            f"auto build kernel = {kind3} (grid/shift gates fell back "
+            f"as designed)")
 
         sub = 512                       # rows per serving sub-worker
         mw3 = -(-g3.n // sub)
         dc3 = DistributionController("div", sub, mw3, g3.n)
         out3 = tempfile.mkdtemp(prefix="dos-road-")
         try:
-            # TPU build: the ELL fallback, 64 timed rows (irregular
-            # graphs are the gather-hostile regime; honesty is the point)
+            # TPU build via the auto-picked kernel (ELL+COO split for
+            # degree-skewed graphs), 64 timed rows (irregular graphs are
+            # the gather-hostile regime; honesty is the point)
             trows = 64
             dg3 = DeviceGraph.from_graph(g3)
-            from distributed_oracle_search_tpu.ops import build_fm_columns
+            if kind3 == "ellsplit":
+                from distributed_oracle_search_tpu.ops.ell_split import (
+                    build_fm_columns_ellsplit,
+                )
+                build3 = lambda t: build_fm_columns_ellsplit(  # noqa: E731
+                    dg3, st3k, t)
+            elif kind3 == "shift":
+                from distributed_oracle_search_tpu.ops.shift_relax import (
+                    build_fm_columns_shift,
+                )
+                build3 = lambda t: build_fm_columns_shift(  # noqa: E731
+                    dg3, st3k, t)
+            elif kind3 == "sweep":
+                from distributed_oracle_search_tpu.ops.grid_sweep import (
+                    build_fm_columns_sweep,
+                )
+                build3 = lambda t: build_fm_columns_sweep(  # noqa: E731
+                    dg3, st3k, t)
+            else:
+                from distributed_oracle_search_tpu.ops import (
+                    build_fm_columns,
+                )
+                build3 = lambda t: build_fm_columns(  # noqa: E731
+                    dg3, jnp.asarray(t))
             tgt64 = np.arange(trows, dtype=np.int32)
-            jax.block_until_ready(
-                build_fm_columns(dg3, jnp.asarray(tgt64)))   # compile
+            jax.block_until_ready(build3(tgt64))             # compile
             with Timer() as t_b3:
-                fm64 = np.asarray(build_fm_columns(
-                    dg3, jnp.asarray(tgt64)))
+                fm64 = np.asarray(build3(tgt64))
             tpu_rps3 = trows / t_b3.interval
-            log(f"road TPU build (ell): {trows} rows in {t_b3} -> "
+            log(f"road TPU build ({kind3}): {trows} rows in {t_b3} -> "
                 f"{tpu_rps3:,.1f} rows/s")
 
             bins = (_native_bins()

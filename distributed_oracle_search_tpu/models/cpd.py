@@ -97,28 +97,39 @@ SWEEP_COVERAGE_MIN = 0.75
 #: scan overhead (measured crossover ~25k nodes on v5e)
 SWEEP_MIN_NODES = 32_768
 
+#: modeled ELL+COO split cost ratio below which auto prefers the split
+#: over the plain padded-ELL gather (degree-skewed graphs: road networks
+#: pad K to the max degree while the mean is ~4)
+ELLSPLIT_RATIO_MAX = 0.75
+
 
 def pick_build_kernel(graph: Graph, method: str = "auto"):
     """Resolve the build-method knob to ``(kind, structure)``.
 
-    ``kind`` ∈ {"sweep", "shift", "ell"}; ``structure`` is the matching
-    host-side bundle (GridGraph / ShiftGraph / None). The coverage
-    decisions happen on host-side split arrays — graphs that fall back
-    never pay a device transfer.
+    ``kind`` ∈ {"sweep", "shift", "ellsplit", "ell"}; ``structure`` is
+    the matching host-side bundle (GridGraph / ShiftGraph /
+    ELLSplitGraph / None). The coverage decisions happen on host-side
+    split arrays — graphs that fall back never pay a device transfer.
 
     ``auto`` picks the fast-sweeping build for large grid-structured
     graphs (O(cycles) not O(hop-diameter) — the only build that scales to
     the 100k+-node regime), the shift relaxation for smaller or
-    non-lattice-but-banded graphs, and the padded-ELL gather otherwise.
+    non-lattice-but-banded graphs, the ELL+COO split for degree-skewed
+    irregular graphs (road networks), and the padded-ELL gather
+    otherwise.
     """
     from ..ops.device_graph import JINF
+    from ..ops.ell_split import ell_split_graph, split_ratio
     from ..ops.grid_sweep import GridGraph
     from ..ops.shift_relax import ShiftGraph, split_coverage
 
-    if method not in ("auto", "ell", "shift", "sweep"):
+    if method not in ("auto", "ell", "ellsplit", "shift", "sweep"):
         raise ValueError(f"unknown build method {method!r}")
     if method == "ell":
         return "ell", None
+    if method == "ellsplit":
+        _, k0 = split_ratio(np.diff(graph.out_ptr), graph.max_out_degree)
+        return "ellsplit", ell_split_graph(graph, k0=k0)
     if method in ("auto", "sweep"):
         split = graph.grid_split()
         if split is not None:
@@ -140,6 +151,12 @@ def pick_build_kernel(graph: Graph, method: str = "auto"):
     shifts, w_shift, nbr_left, w_left = graph.shift_split()
     if method == "auto" and split_coverage(w_shift,
                                            w_left) < SHIFT_COVERAGE_MIN:
+        # irregular graph: split the padded ELL when the degree skew
+        # makes it worthwhile (cost model in ops.ell_split)
+        ratio, k0 = split_ratio(np.diff(graph.out_ptr),
+                                graph.max_out_degree)
+        if ratio <= ELLSPLIT_RATIO_MAX:
+            return "ellsplit", ell_split_graph(graph, k0=k0)
         return "ell", None
     return "shift", ShiftGraph(shifts, w_shift, nbr_left, w_left, graph.n)
 
@@ -178,6 +195,7 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     (SURVEY.md §5 checkpoint/resume).
     """
     from ..ops import build_fm_columns
+    from ..ops.ell_split import build_fm_columns_ellsplit
     from ..ops.grid_sweep import build_fm_columns_sweep
     from ..ops.shift_relax import build_fm_columns_shift
 
@@ -208,6 +226,9 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         elif kind == "shift":
             fm = build_fm_columns_shift(dg, structure, pad,
                                         max_iters=max_iters)
+        elif kind == "ellsplit":
+            fm = build_fm_columns_ellsplit(dg, structure, pad,
+                                           max_iters=max_iters)
         else:
             fm = build_fm_columns(dg, jnp.asarray(pad), max_iters=max_iters)
         return np.asarray(fm)[:len(tgts)]
@@ -330,13 +351,12 @@ class CPDOracle:
     def save(self, outdir: str) -> None:
         """Write the CPD index: one .npy per (worker, block) + manifest.
 
-        Multi-controller safe: with >1 JAX process each (worker, block)
-        slice is allgathered SEPARATELY (its shards live on
-        non-addressable devices) and only process 0 writes — no host
-        ever materializes the full ``[W, R, N]`` table (at the README's
-        NY scale that would be 70 GB of RAM per controller just to let
-        process 0 write), and concurrent controllers never race on the
-        shared index directory."""
+        Multi-controller safe: with >1 JAX process each WORKER's slice
+        is allgathered separately (its shards live on non-addressable
+        devices) and only process 0 writes — host memory peaks at 1/W of
+        the table (at the README's NY scale: 8.7 GB instead of 70 GB per
+        controller), and concurrent controllers never race on the shared
+        index directory."""
         if self.fm is None:
             raise RuntimeError("build() or load() before save()")
         multi = jax.process_count() > 1

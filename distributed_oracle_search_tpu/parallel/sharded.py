@@ -73,6 +73,7 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
     round-2 weak-scaling bench REGRESSED with worker count).
     """
     from ..ops.bellman_ford import dist_to_targets, first_move_from_dist
+    from ..ops.ell_split import _ellsplit_dist_fn
     from ..ops.grid_sweep import _sweep_dist_fn
     from ..ops.shift_relax import _dist_fn
 
@@ -82,6 +83,9 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
     elif kind == "shift":
         n_kernel_ops = 3
         kernel_dist = _dist_fn(*kernel_sig, max_iters)
+    elif kind == "ellsplit":
+        n_kernel_ops = 5
+        kernel_dist = _ellsplit_dist_fn(*kernel_sig, max_iters)
     else:
         n_kernel_ops = 0
         kernel_dist = None
@@ -148,6 +152,11 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
                        kernel_sig=(st.shifts, st.n, st.k_left))
         build = lambda dg_, t_: fn(  # noqa: E731
             dg_, st.w_shift, st.nbr_left, st.w_left, t_)
+    elif kind == "ellsplit":
+        fn = _build_fn(mesh, w, max_iters, with_dists, kind="ellsplit",
+                       kernel_sig=(st.n, st.k0, len(st.u_ov)))
+        build = lambda dg_, t_: fn(  # noqa: E731
+            dg_, st.nbr0, st.w0, st.u_ov, st.v_ov, st.w_ov, t_)
     else:
         build = _build_fn(mesh, w, max_iters, with_dists)
     if chunk <= 0 or chunk >= r:

@@ -43,11 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chunk", type=int, default=0,
                    help="build-step rows (0 = whole shard at once)")
     p.add_argument("--method", default="auto",
-                   choices=["auto", "sweep", "shift", "ell"],
+                   choices=["auto", "sweep", "shift", "ellsplit", "ell"],
                    help="relaxation kernel: fast-sweeping grid scans, "
-                        "gather-free shift path, padded-ELL gather, or "
-                        "auto by structure gates (models.cpd."
-                        "pick_build_kernel)")
+                        "gather-free shift path, ELL+COO split (degree-"
+                        "skewed graphs), padded-ELL gather, or auto by "
+                        "structure gates (models.cpd.pick_build_kernel)")
     p.add_argument("--no-resume", action="store_true",
                    help="rebuild blocks even if their files exist")
     p.add_argument("-v", "--verbose", action="count", default=0)
