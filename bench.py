@@ -303,9 +303,10 @@ def main() -> None:
     log(f"dist gather:   {n_queries} in {t_dist} -> "
         f"{n_queries / t_dist.interval:,.0f} q/s")
 
-    # ---- roofline: the walk does ~3 scalar gathers per step per query
-    # (fm slot, per-slot weight, next node); compare achieved rate to a
-    # calibrated dependent-gather micro-kernel of the same shape
+    # ---- roofline: the walk does 2 scalar gathers per step per query
+    # (fm slot + the packed (next-node, weight) pair); compare achieved
+    # rate to a calibrated dependent-gather micro-kernel of the same
+    # shape
     from distributed_oracle_search_tpu.ops.table_search import pick_buckets
 
     peak_gather = _calibrate_gather(g.n, n_queries)
@@ -336,9 +337,10 @@ def main() -> None:
     lanes_dev = (np.ceil(per_bucket_max / unroll) * unroll).sum(
         axis=2) * qb                                  # [D, W] per device
     lanes_issued = float(lanes_dev.max())
-    achieved_gather = (n_queries / (dgrid * wgrid)) * mean_plen * 3 \
-        / t_kern.interval
-    issued_gather = lanes_issued * 3 / t_kern.interval
+    gathers_per_step = 2          # fm slot + packed (next, weight) pair
+    achieved_gather = ((n_queries / (dgrid * wgrid)) * mean_plen
+                       * gathers_per_step / t_kern.interval)
+    issued_gather = lanes_issued * gathers_per_step / t_kern.interval
     log(f"roofline: kernel {t_kern.interval:.3f}s, peak gather "
         f"{peak_gather / 1e6:,.0f} M elem/s, "
         f"useful {achieved_gather / 1e6:,.0f} "
