@@ -217,31 +217,44 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     kind, structure = pick_build_kernel(graph, method)
     dg = DeviceGraph.from_graph(graph)
 
-    def compute(tgts: np.ndarray) -> np.ndarray:
+    def compute_dev(tgts: np.ndarray):
+        """Dispatch one chunk's kernel; returns the DEVICE array (async —
+        the fetch happens one block behind, so the device computes block
+        b+1 while the host drains and writes block b)."""
         pad = np.full(chunk, -1, np.int32)  # fixed shape -> one compile
         pad[:len(tgts)] = tgts
         if kind == "sweep":
-            fm = build_fm_columns_sweep(dg, structure, pad,
-                                        max_iters=max_iters)
-        elif kind == "shift":
-            fm = build_fm_columns_shift(dg, structure, pad,
-                                        max_iters=max_iters)
-        elif kind == "ellsplit":
-            fm = build_fm_columns_ellsplit(dg, structure, pad,
-                                           max_iters=max_iters)
-        else:
-            fm = build_fm_columns(dg, jnp.asarray(pad), max_iters=max_iters)
-        return np.asarray(fm)[:len(tgts)]
+            return build_fm_columns_sweep(dg, structure, pad,
+                                          max_iters=max_iters)
+        if kind == "shift":
+            return build_fm_columns_shift(dg, structure, pad,
+                                          max_iters=max_iters)
+        if kind == "ellsplit":
+            return build_fm_columns_ellsplit(dg, structure, pad,
+                                             max_iters=max_iters)
+        return build_fm_columns(dg, jnp.asarray(pad), max_iters=max_iters)
+
+    def flush(entry) -> None:
+        bid, lens, devs = entry
+        parts = jax.device_get(devs)        # ONE host fetch per block
+        trimmed = [p[:ln] for p, ln in zip(parts, lens)]
+        np.save(os.path.join(outdir, shard_block_name(wid, bid)),
+                trimmed[0] if len(trimmed) == 1
+                else np.concatenate(trimmed))
 
     written = []
+    pending = None                          # one block in flight
     for bid in missing:
         blk = owned[bid * bs: min((bid + 1) * bs, len(owned))]
-        parts = [compute(blk[i:i + chunk])
-                 for i in range(0, len(blk), chunk)]
-        fname = shard_block_name(wid, bid)
-        np.save(os.path.join(outdir, fname),
-                parts[0] if len(parts) == 1 else np.concatenate(parts))
-        written.append(fname)
+        lens = [len(blk[i:i + chunk]) for i in range(0, len(blk), chunk)]
+        devs = [compute_dev(blk[i:i + chunk])
+                for i in range(0, len(blk), chunk)]
+        if pending is not None:
+            flush(pending)
+        pending = (bid, lens, devs)
+        written.append(shard_block_name(wid, bid))
+    if pending is not None:
+        flush(pending)
     return written
 
 
