@@ -266,6 +266,17 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
 def run(conf: ClusterConfig, args):
     """The campaign: returns ``(data, stats)`` with the reference's shapes
     (reference ``process_query.py:132-194``)."""
+    if getattr(args, "order", None):
+        # reordering relabels node ids EVERYWHERE (graph, index, scen,
+        # diffs); doing it per-campaign would desync from the on-disk
+        # index. The supported flow reorders the dataset once, up front.
+        raise SystemExit(
+            "--order is applied at dataset-preparation time, not per "
+            "campaign: run `python -m distributed_oracle_search_tpu."
+            f"cli.reorder --input {conf.xy_file} --order {args.order} "
+            "-o <out.xy> --scen <in> <out>` once and point the conf at "
+            "the reordered files (build + serve then agree by "
+            "construction).")
     scen = conf.scenfile or args.scenario
     with Timer() as t_read:
         queries = read_scen(scen)

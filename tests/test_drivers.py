@@ -511,3 +511,16 @@ def test_tpu_campaign_astar(dataset, tmp_path):
     # ch is native-only; TPU mode must say so loudly
     with pytest.raises(SystemExit, match="native"):
         pq.run(conf, parse_args(["--alg", "ch", "--backend", "tpu"]))
+
+
+def test_order_flag_points_to_reorder_tool(dataset, tmp_path):
+    """--order on a campaign fails fast with the dataset-prep guidance
+    (reordering per campaign would desync from the on-disk index)."""
+    datadir, paths = dataset
+    conf = ClusterConfig(
+        workers=["tpu"], partmethod="tpu", partkey=1,
+        outdir=str(tmp_path / "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"], diffs=["-"],
+    ).validate()
+    with pytest.raises(SystemExit, match="cli.reorder"):
+        pq.run(conf, parse_args(["--order", "rcm"]))
