@@ -644,12 +644,17 @@ def main() -> None:
         dc3 = DistributionController("div", sub, mw3, g3.n)
         out3 = tempfile.mkdtemp(prefix="dos-road-")
         try:
-            # TPU build via the auto-picked kernel (ELL+COO split for
-            # degree-skewed graphs), 64 timed rows (irregular graphs are
-            # the gather-hostile regime; honesty is the point)
-            trows = 64
+            # TPU build via the auto-picked kernel (delta-stepping
+            # frontier queue on the RCM-ordered road graph), 512 timed
+            # rows — the same row count the CPU build below is timed on
+            trows = 512
             dg3 = DeviceGraph.from_graph(g3)
-            if kind3 == "ellsplit":
+            if kind3 == "frontier":
+                from distributed_oracle_search_tpu.ops.frontier_relax \
+                    import build_fm_columns_frontier
+                build3 = lambda t: build_fm_columns_frontier(  # noqa: E731
+                    dg3, st3k, t)
+            elif kind3 == "ellsplit":
                 from distributed_oracle_search_tpu.ops.ell_split import (
                     build_fm_columns_ellsplit,
                 )
@@ -676,7 +681,7 @@ def main() -> None:
             tgt64 = np.arange(trows, dtype=np.int32)
             jax.block_until_ready(build3(tgt64))             # compile
             with Timer() as t_b3:
-                fm64 = np.asarray(build3(tgt64))
+                fm64 = np.asarray(build3(tgt64))             # [512, N]
             tpu_rps3 = trows / t_b3.interval
             log(f"road TPU build ({kind3}): {trows} rows in {t_b3} -> "
                 f"{tpu_rps3:,.1f} rows/s")

@@ -30,7 +30,7 @@ from __future__ import annotations
 import numpy as np
 
 from .formats import INT_WEIGHT_DTYPE, write_xy
-from .graph import Graph
+from .graph import Graph, INF
 
 
 def read_gr(path: str):
@@ -49,7 +49,15 @@ def read_gr(path: str):
                 _, u, v, ww = line.split()
                 src[ei] = int(u) - 1
                 dst[ei] = int(v) - 1
-                w[ei] = int(ww)
+                wi = int(ww)
+                # mirror the endpoint check: a weight at/over INF (or
+                # negative) would wrap the int32 min-plus arithmetic
+                # downstream (INF+INF < int32 max is the invariant)
+                if not 0 <= wi < int(INF):
+                    raise ValueError(
+                        f"{path}: arc {u}->{v} weight {wi} outside "
+                        f"[0, {int(INF)})")
+                w[ei] = wi
                 ei += 1
             elif tag == "p":
                 toks = line.split()

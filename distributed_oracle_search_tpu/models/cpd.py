@@ -102,31 +102,49 @@ SWEEP_MIN_NODES = 32_768
 #: pad K to the max degree while the mean is ~4)
 ELLSPLIT_RATIO_MAX = 0.75
 
+#: below this node count the dense kernels' full sweeps are cheap enough
+#: that the frontier queue's per-pop overhead does not pay
+FRONTIER_MIN_NODES = 32_768
+
+#: minimum edge id-locality (ops.frontier_relax.locality_fraction) for
+#: the delta-stepping frontier build: under it the union wavefront of a
+#: clustered target batch degenerates to the whole graph (measured 0.4-
+#: 0.6 after RCM/BFS reorder vs 0.02 on shuffled ids)
+FRONTIER_LOCALITY_MIN = 0.25
+
 
 def pick_build_kernel(graph: Graph, method: str = "auto"):
     """Resolve the build-method knob to ``(kind, structure)``.
 
-    ``kind`` ∈ {"sweep", "shift", "ellsplit", "ell"}; ``structure`` is
-    the matching host-side bundle (GridGraph / ShiftGraph /
-    ELLSplitGraph / None). The coverage decisions happen on host-side
-    split arrays — graphs that fall back never pay a device transfer.
+    ``kind`` ∈ {"sweep", "shift", "frontier", "ellsplit", "ell"};
+    ``structure`` is the matching host-side bundle (GridGraph /
+    ShiftGraph / FrontierGraph / ELLSplitGraph / None). The coverage
+    decisions happen on host-side split arrays — graphs that fall back
+    never pay a device transfer.
 
     ``auto`` picks the fast-sweeping build for large grid-structured
     graphs (O(cycles) not O(hop-diameter) — the only build that scales to
     the 100k+-node regime), the shift relaxation for smaller or
-    non-lattice-but-banded graphs, the ELL+COO split for degree-skewed
-    irregular graphs (road networks), and the padded-ELL gather
+    non-lattice-but-banded graphs, the delta-stepping frontier queue for
+    large locality-ordered irregular graphs (road networks after
+    BFS/RCM reorder — the only irregular build whose work tracks the
+    frontier instead of N x diameter), the ELL+COO split for the
+    remaining degree-skewed irregular graphs, and the padded-ELL gather
     otherwise.
     """
     from ..ops.device_graph import JINF
     from ..ops.ell_split import ell_split_graph, split_ratio
+    from ..ops.frontier_relax import frontier_graph, locality_fraction
     from ..ops.grid_sweep import GridGraph
     from ..ops.shift_relax import ShiftGraph, split_coverage
 
-    if method not in ("auto", "ell", "ellsplit", "shift", "sweep"):
+    if method not in ("auto", "ell", "ellsplit", "frontier", "shift",
+                      "sweep"):
         raise ValueError(f"unknown build method {method!r}")
     if method == "ell":
         return "ell", None
+    if method == "frontier":
+        return "frontier", frontier_graph(graph)
     if method == "ellsplit":
         _, k0 = split_ratio(np.diff(graph.out_ptr), graph.max_out_degree)
         return "ellsplit", ell_split_graph(graph, k0=k0)
@@ -151,8 +169,13 @@ def pick_build_kernel(graph: Graph, method: str = "auto"):
     shifts, w_shift, nbr_left, w_left = graph.shift_split()
     if method == "auto" and split_coverage(w_shift,
                                            w_left) < SHIFT_COVERAGE_MIN:
-        # irregular graph: split the padded ELL when the degree skew
-        # makes it worthwhile (cost model in ops.ell_split)
+        # irregular graph: the frontier queue when ids have locality
+        # (post-reorder road networks — its work tracks the wavefront,
+        # not N x diameter), else split the padded ELL when the degree
+        # skew makes it worthwhile (cost model in ops.ell_split)
+        if (graph.n >= FRONTIER_MIN_NODES
+                and locality_fraction(graph) >= FRONTIER_LOCALITY_MIN):
+            return "frontier", frontier_graph(graph)
         ratio, k0 = split_ratio(np.diff(graph.out_ptr),
                                 graph.max_out_degree)
         if ratio <= ELLSPLIT_RATIO_MAX:
@@ -196,6 +219,7 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     """
     from ..ops import build_fm_columns
     from ..ops.ell_split import build_fm_columns_ellsplit
+    from ..ops.frontier_relax import build_fm_columns_frontier
     from ..ops.grid_sweep import build_fm_columns_sweep
     from ..ops.shift_relax import build_fm_columns_shift
 
@@ -229,6 +253,9 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         if kind == "shift":
             return build_fm_columns_shift(dg, structure, pad,
                                           max_iters=max_iters)
+        if kind == "frontier":
+            return build_fm_columns_frontier(dg, structure, pad,
+                                             max_iters=max_iters)
         if kind == "ellsplit":
             return build_fm_columns_ellsplit(dg, structure, pad,
                                              max_iters=max_iters)
@@ -345,8 +372,10 @@ class CPDOracle:
         of the graph; rebuild to get them back).
 
         ``method``: ``"sweep"`` forces the fast-sweeping build, ``"shift"``
-        the gather-free shift relaxation, ``"ell"`` the padded-ELL gather
-        relaxation; ``"auto"`` resolves per :func:`pick_build_kernel`.
+        the gather-free shift relaxation, ``"frontier"`` the
+        delta-stepping queue, ``"ell"``/``"ellsplit"`` the (split)
+        padded-ELL gather; ``"auto"`` resolves per
+        :func:`pick_build_kernel`.
         """
         kind, structure = pick_build_kernel(self.graph, method)
         if store_dists:

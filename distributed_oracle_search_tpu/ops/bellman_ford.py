@@ -98,16 +98,27 @@ def first_move_from_dist(dg: DeviceGraph, targets: jnp.ndarray,
     """First-move table int8 [B, N] from converged distances.
 
     ``fm[b, x]`` = out-edge slot of x minimizing ``w + d(nbr → targets[b])``
-    (first minimal slot on ties — ``jnp.argmin`` picks the first occurrence,
-    same rule as the CPU oracle). ``-1`` for unreachable, for the target row
-    itself, and for padding rows (targets[b] < 0).
+    (first minimal slot on ties, same rule as the CPU oracle). ``-1`` for
+    unreachable, for the target row itself, and for padding rows
+    (targets[b] < 0).
+
+    The argmin runs as a running scan over the K out-slots (ascending, so
+    the FIRST minimal slot wins — ``jnp.argmin`` semantics) in the same
+    [N, B] batch-minor layout as the relaxation: a one-shot ``[N, K, B]``
+    argmin materializes a K-times-larger temp, which at build batch 512
+    on a 264k-node road graph is a 10.8 GB allocation — over HBM.
     """
-    # same [N, K, B] batch-minor layout as the relaxation (see _relax_nb)
-    via = dg.w_pad[dg.out_eid][:, :, None] + dist.T[dg.out_nbr, :]
-    via = jnp.minimum(via, JINF)
-    best = via.min(axis=1).T
-    fm = jnp.argmin(via, axis=1).astype(jnp.int8).T
-    fm = jnp.where(best >= JINF, jnp.int8(-1), fm)
+    dist_nb = dist.T
+    best = jnp.full(dist_nb.shape, JINF, jnp.int32)
+    fm_nb = jnp.zeros(dist_nb.shape, jnp.int8)
+    for k in range(dg.k):
+        via_k = jnp.minimum(
+            dg.w_pad[dg.out_eid[:, k]][:, None] + dist_nb[dg.out_nbr[:, k]],
+            JINF)
+        upd = via_k < best
+        fm_nb = jnp.where(upd, jnp.int8(k), fm_nb)
+        best = jnp.where(upd, via_k, best)
+    fm = jnp.where(best.T >= JINF, jnp.int8(-1), fm_nb.T)
     # target's own row: no move
     b = targets.shape[0]
     n = dg.n

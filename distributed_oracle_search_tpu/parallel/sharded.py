@@ -61,10 +61,12 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
 
     ``kind`` selects the distance stage: ``"sweep"`` (fast-sweeping grid
     scans, sig ``(h, w, shifts, n_left)``), ``"shift"`` (gather-free shift
-    relaxation, sig ``(shifts, n, k_left)``) or ``"ell"`` (padded-ELL
-    gather, no sig). Extra kernel operands arrive replicated. Everything
-    else — shardings, target layout, first-move extraction, with_dists
-    outputs — is shared, so the paths cannot drift.
+    relaxation, sig ``(shifts, n, k_left)``), ``"frontier"``
+    (delta-stepping queue, sig ``(n, f, delta, s_unroll)``),
+    ``"ellsplit"`` or ``"ell"`` (padded-ELL gather, no sig). Extra kernel
+    operands arrive replicated. Everything else — shardings, target
+    layout, first-move extraction, with_dists outputs — is shared, so
+    the paths cannot drift.
 
     Runs under ``shard_map`` so each shard's relaxation ``while_loop``
     converges on its OWN flag — no per-sweep all-reduce, no
@@ -74,9 +76,11 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
     """
     from ..ops.bellman_ford import dist_to_targets, first_move_from_dist
     from ..ops.ell_split import _ellsplit_dist_fn
+    from ..ops.frontier_relax import _frontier_dist_fn
     from ..ops.grid_sweep import _sweep_dist_fn
     from ..ops.shift_relax import _dist_fn
 
+    frontier = False
     if kind == "sweep":
         n_kernel_ops = 8
         kernel_dist = _sweep_dist_fn(*kernel_sig, max_iters)
@@ -86,6 +90,12 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
     elif kind == "ellsplit":
         n_kernel_ops = 5
         kernel_dist = _ellsplit_dist_fn(*kernel_sig, max_iters)
+    elif kind == "frontier":
+        # frontier consumes the DeviceGraph arrays too (sig carries the
+        # queue knobs); only in_nbr is an extra operand
+        n_kernel_ops = 1
+        frontier = True
+        kernel_dist = _frontier_dist_fn(*kernel_sig, max_iters)
     else:
         n_kernel_ops = 0
         kernel_dist = None
@@ -95,7 +105,10 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
         # operands replicated
         *kernel_ops, tgt_b1 = ops_and_tgt
         tgts = tgt_b1.reshape(-1)
-        if kernel_dist is not None:
+        if frontier:
+            dist = kernel_dist(dg.out_nbr, dg.out_eid, dg.w_pad,
+                               *kernel_ops, tgts)
+        elif kernel_dist is not None:
             dist = kernel_dist(*kernel_ops, tgts)
         else:
             dist = dist_to_targets(dg, tgts, max_iters=max_iters)
@@ -157,6 +170,10 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
                        kernel_sig=(st.n, st.k0, len(st.u_ov)))
         build = lambda dg_, t_: fn(  # noqa: E731
             dg_, st.nbr0, st.w0, st.u_ov, st.v_ov, st.w_ov, t_)
+    elif kind == "frontier":
+        fn = _build_fn(mesh, w, max_iters, with_dists, kind="frontier",
+                       kernel_sig=(st.n, st.f, st.delta, st.s_unroll))
+        build = lambda dg_, t_: fn(dg_, st.in_nbr, t_)  # noqa: E731
     else:
         build = _build_fn(mesh, w, max_iters, with_dists)
     if chunk <= 0 or chunk >= r:

@@ -154,23 +154,30 @@ class FifoServer:
     def _next_line(self, fd: int, timeout: float | None = None):
         """Next newline-terminated line off the persistent FIFO fd (own
         buffering — a buffered file object would hide pipe data from
-        ``select``). ``timeout`` bounds the wait (None = forever); returns
-        None on timeout."""
+        ``select``). ``timeout`` bounds the TOTAL wait (None = forever):
+        the deadline is absolute, so a byte-trickling writer that keeps
+        waking ``select`` without ever completing a line cannot hold a
+        half-frame wait open indefinitely. Returns None on timeout."""
         import select
+        import time as _time
 
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
         while True:
             nl = self._rdbuf.find(b"\n")
             if nl >= 0:
                 line = self._rdbuf[:nl + 1]
                 self._rdbuf = self._rdbuf[nl + 1:]
                 return line.decode(errors="replace")
-            if timeout is not None:
-                ready, _, _ = select.select([fd], [], [], timeout)
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return None
+                ready, _, _ = select.select([fd], [], [], remaining)
                 if not ready:
                     return None
             chunk = os.read(fd, 4096)
             if not chunk:       # cannot happen with our own O_RDWR write
-                import time as _time
                 _time.sleep(0.01)  # defensive: never spin
             self._rdbuf += chunk
 
@@ -187,16 +194,18 @@ class FifoServer:
         # has not already opened — same guard as the native server's
         return v if v > 0 else 30.0
 
-    def _reply(self, answerfifo: str, line: str) -> None:
+    def _reply(self, answerfifo: str, line: str,
+               deadline_s: float | None = None) -> None:
         """Write the stats line without ever wedging the server: a
         blocking ``open(fifo, 'w')`` would hang forever if the head's
         ``cat <answer>`` was killed before opening its end. Non-blocking
-        open with a bounded deadline; drop the reply (logged) if no
-        reader appears."""
+        open with a bounded deadline (``deadline_s`` overrides the
+        configured one); drop the reply (logged) if no reader appears."""
         import errno
         import time as _time
 
-        wait_s = self.reply_deadline_s
+        wait_s = (deadline_s if deadline_s is not None
+                  else self.reply_deadline_s)
         deadline = _time.monotonic() + wait_s
         fd = -1
         while fd < 0:
@@ -224,6 +233,12 @@ class FifoServer:
         finally:
             os.close(fd)
 
+    #: reader-wait for best-effort malformed replies: a garbage frame's
+    #: "answer FIFO" may be a stray path nobody reads, and the full
+    #: reply deadline (default 30 s) would stall the single-threaded
+    #: serve loop that long PER garbage frame
+    MALFORMED_REPLY_DEADLINE_S = 2.0
+
     def _answer_malformed(self, text: str) -> None:
         """Best effort: find an answer-FIFO path among the tokens of a
         malformed request (any line — a stray paths line carries it in
@@ -236,7 +251,9 @@ class FifoServer:
                 try:
                     if stat.S_ISFIFO(os.stat(tok).st_mode):
                         self._reply(tok,
-                                    StatsRow.failed().encode_wire() + "\n")
+                                    StatsRow.failed().encode_wire() + "\n",
+                                    deadline_s=self
+                                    .MALFORMED_REPLY_DEADLINE_S)
                         return
                 except OSError:
                     continue

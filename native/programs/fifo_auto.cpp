@@ -307,14 +307,21 @@ struct Server {
     // line-buffered reader over the persistent command-FIFO fd
     std::string fifo_pending;
 
-    // next newline-terminated line; timeout_ms < 0 waits forever.
+    // next newline-terminated line; timeout_ms < 0 waits forever. The
+    // deadline is ABSOLUTE (poll gets the remaining time, not a fresh
+    // window): a byte-trickling writer that keeps waking poll without
+    // completing a line cannot hold a half-frame wait open forever.
     // Returns false on timeout (line untouched).
     bool next_line(int fd, std::string* line, int timeout_ms = -1) {
         size_t nl;
+        double give_up = timeout_ms >= 0 ? now_s() + timeout_ms / 1000.0
+                                         : 0.0;
         while ((nl = fifo_pending.find('\n')) == std::string::npos) {
             if (timeout_ms >= 0) {
+                double rem_s = give_up - now_s();
+                if (rem_s <= 0) return false;
                 struct pollfd p{fd, POLLIN, 0};
-                int r = ::poll(&p, 1, timeout_ms);
+                int r = ::poll(&p, 1, int(rem_s * 1000) + 1);
                 if (r == 0) return false;
                 if (r < 0 && errno != EINTR)
                     die(std::string("poll ") + fifo_path + ": " +

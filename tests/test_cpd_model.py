@@ -262,3 +262,116 @@ def test_auto_picks_ellsplit_for_degree_skewed(toy_graph):
     for (s, t), cc, ff in zip(q, c, f):
         d = dist_to_target(g, int(t))[int(s)]
         assert (cc == d) if ff else d >= 10**9
+
+
+def test_frontier_build_matches_plain_ell(toy_graph):
+    """The delta-stepping frontier relaxation must produce bit-identical
+    first moves to the plain padded-ELL kernel (same fixed point, same
+    tie-breaks) — including with a tiny pop capacity F that forces queue
+    overflow every iteration."""
+    import jax.numpy as jnp
+
+    from distributed_oracle_search_tpu.data import synth_road_network
+    from distributed_oracle_search_tpu.ops import (
+        DeviceGraph, build_fm_columns,
+    )
+    from distributed_oracle_search_tpu.ops.frontier_relax import (
+        build_fm_columns_frontier, frontier_graph,
+    )
+
+    for g, f in ((toy_graph, None), (synth_road_network(600, seed=2), None),
+                 (synth_road_network(600, seed=2), 32)):
+        dg = DeviceGraph.from_graph(g)
+        fg = frontier_graph(g, f=f)
+        tgts = np.arange(0, g.n, 3, dtype=np.int32)
+        ref = np.asarray(build_fm_columns(dg, jnp.asarray(tgts)))
+        got = np.asarray(build_fm_columns_frontier(dg, fg, tgts))
+        np.testing.assert_array_equal(got, ref)
+    # padded target rows stay all -1
+    g = synth_road_network(600, seed=2)
+    dg = DeviceGraph.from_graph(g)
+    fg = frontier_graph(g)
+    tg2 = np.asarray([5, -1, 77, -1], np.int32)
+    ref = np.asarray(build_fm_columns(dg, jnp.asarray(tg2)))
+    got = np.asarray(build_fm_columns_frontier(dg, fg, tg2))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_frontier_auto_gate():
+    """auto picks the frontier queue only for big graphs whose ids have
+    locality (post-RCM road nets); shuffled ids of the SAME graph fall
+    back to the dense split kernel (the union wavefront would span the
+    whole graph), and small graphs stay dense."""
+    from distributed_oracle_search_tpu.data import synth_road_network
+    from distributed_oracle_search_tpu.models.cpd import (
+        FRONTIER_MIN_NODES, pick_build_kernel,
+    )
+    from distributed_oracle_search_tpu.ops.frontier_relax import (
+        locality_fraction,
+    )
+
+    g = synth_road_network(FRONTIER_MIN_NODES, seed=1)
+    g_rcm = g.reorder(g.rcm_order())
+    assert locality_fraction(g_rcm) > locality_fraction(g)
+    kind, st = pick_build_kernel(g_rcm, "auto")
+    assert kind == "frontier"
+    assert st.in_nbr.shape[0] == g.n
+    # same graph, shuffled ids -> dense fallback
+    kind2, _ = pick_build_kernel(g, "auto")
+    assert kind2 == "ellsplit"
+    # small irregular graph -> dense regardless of locality
+    small = synth_road_network(800, seed=5)
+    kind3, _ = pick_build_kernel(small.reorder(small.rcm_order()), "auto")
+    assert kind3 == "ellsplit"
+
+
+def test_frontier_sharded_build_matches_cpu_oracle(toy_graph):
+    """method='frontier' through the sharded build path (shard_map)
+    answers queries identically to the CPU oracle."""
+    from distributed_oracle_search_tpu.data import synth_road_network
+    from distributed_oracle_search_tpu.models.reference import (
+        dist_to_target,
+    )
+
+    g = synth_road_network(800, seed=5)
+    dc = DistributionController("tpu", None, 8, g.n)
+    o = CPDOracle(g, dc, mesh=make_mesh(n_workers=8)).build(
+        method="frontier")
+    rng = np.random.default_rng(1)
+    q = np.stack([rng.integers(0, g.n, 32), rng.integers(0, g.n, 32)],
+                 axis=1)
+    c, p, f = o.query(q)
+    for (s, t), cc, ff in zip(q, c, f):
+        d = dist_to_target(g, int(t))[int(s)]
+        assert (cc == d) if ff else d >= 10**9
+
+
+def test_frontier_build_program_has_no_collectives(toy_graph):
+    """The frontier build under shard_map must stay embarrassingly
+    parallel: per-shard queue convergence, ZERO cross-shard traffic
+    (same property the dense kernels pin)."""
+    import jax.numpy as jnp
+
+    from distributed_oracle_search_tpu.data import synth_road_network
+    from distributed_oracle_search_tpu.ops import DeviceGraph
+    from distributed_oracle_search_tpu.ops.frontier_relax import (
+        frontier_graph,
+    )
+    from distributed_oracle_search_tpu.parallel.sharded import (
+        _build_fn, pad_targets,
+    )
+
+    g = synth_road_network(800, seed=5)
+    fg = frontier_graph(g)
+    dc = DistributionController("tpu", None, 8, g.n)
+    mesh = make_mesh(n_workers=8)
+    dg = DeviceGraph.from_graph(g)
+    tgt = pad_targets(dc)
+    fn = _build_fn(mesh, 8, 0, False, kind="frontier",
+                   kernel_sig=(fg.n, fg.f, fg.delta, fg.s_unroll))
+    compiled = fn.lower(dg, jnp.asarray(fg.in_nbr),
+                        jnp.asarray(tgt.T)).compile()
+    hlo = compiled.as_text()
+    for op in ("all-reduce", "all-gather", "collective-permute",
+               "all-to-all", "reduce-scatter"):
+        assert op not in hlo, f"frontier build contains a {op} collective"

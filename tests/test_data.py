@@ -241,3 +241,22 @@ def test_synth_road_network_properties():
         seen[nxt] = True
         frontier = nxt
     assert seen.all(), "road network must be connected"
+
+
+def test_dimacs_rejects_out_of_range_weight(tmp_path):
+    """A .gr arc weight >= INF (or negative) must be rejected up front:
+    the int32 min-plus relaxation relies on INF+INF < int32 max, which
+    an ingested giant weight would silently wrap."""
+    import pytest
+
+    from distributed_oracle_search_tpu.data.dimacs import read_gr
+
+    for bad in (10**9, -5):
+        p = tmp_path / f"bad{bad}.gr"
+        p.write_text("p sp 2 1\n" f"a 1 2 {bad}\n")
+        with pytest.raises(ValueError, match="weight"):
+            read_gr(str(p))
+    ok = tmp_path / "ok.gr"
+    ok.write_text("p sp 2 1\na 1 2 999999999\n")
+    n, src, dst, w = read_gr(str(ok))
+    assert w[0] == 999999999
