@@ -21,10 +21,22 @@ This is deliberately a single-device serving mode: multi-chip scale-out
 uses the resident sharded oracle (sharding IS the memory plan); streaming
 is the fallback when one chip must serve an index bigger than its HBM,
 and the two share the same walk kernel and wire semantics.
+
+Uploaded row-chunks are kept on device in a bounded LRU (``cache_bytes``):
+campaigns whose targets overlap earlier ones — the resident-server usage
+pattern, one request round per diff (reference ``process_query.py:178``) —
+skip the upload entirely and run at near-resident speed. Range chunks key
+on their row range; compacted chunks are content-addressed by row-id
+digest (an identical chunk — a replayed campaign — hits). Keys are
+independent of the query-time weights: a diff round re-uses every chunk
+the free-flow round uploaded, because fm rows hold free-flow FIRST MOVES
+while diffs only change the cost accumulation (``ops.table_search``
+semantics).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 
@@ -43,6 +55,23 @@ def _pow2(x: int) -> int:
     return 1 << max(x - 1, 0).bit_length()
 
 
+def default_cache_bytes() -> int:
+    """Device-residency budget for cached fm row-chunks: a quarter of
+    the device's reported memory (4 GB on a 16 GB v5e — enough to hold a
+    whole 102k-node worker shard, 1.3 GB, with room to spare, while
+    never crowding out the walk state), falling back to 1 GB when the
+    backend reports no limit. Streaming exists for indexes bigger than
+    HBM, so the cache must scale DOWN with the chip, not assume one."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit // 4
+    except Exception:
+        pass
+    return 1 << 30
+
+
 class StreamedCPDOracle:
     """Serve table-search queries from an on-disk CPD index, streaming
     only the rows each batch needs.
@@ -55,21 +84,54 @@ class StreamedCPDOracle:
     row_chunk  : fm rows resident per upload; the device-memory knob.
                  Working set ≈ ``row_chunk * N`` bytes of int8 fm plus the
                  walk state — e.g. 4096 rows x 264k nodes ≈ 1.1 GB.
+    cache_bytes: device bytes of uploaded fm chunks kept in an LRU
+                 across :meth:`query` calls (0 disables; None — the
+                 default — resolves via :func:`default_cache_bytes`,
+                 a quarter of the device's memory). Campaigns with
+                 overlapping targets — including every diff round
+                 after the first — skip the re-upload.
     """
 
     def __init__(self, graph: Graph, controller: DistributionController,
-                 outdir: str, row_chunk: int = 4096):
+                 outdir: str, row_chunk: int = 4096,
+                 cache_bytes: int | None = None):
         self.graph = graph
         self.dc = controller
         self.outdir = outdir
         self.row_chunk = int(row_chunk)
+        self.cache_bytes = (default_cache_bytes() if cache_bytes is None
+                            else int(cache_bytes))
         self.dg = DeviceGraph.from_graph(graph)
         with open(os.path.join(outdir, "index.json")) as f:
             manifest = json.load(f)
         validate_manifest(manifest, controller, outdir)
         self._blocks: dict[tuple[int, int], np.ndarray] = {}
+        # LRU of device-resident [C, N] chunks, key (wid, r0); insertion
+        # order IS the recency order (moved-to-end on hit)
+        self._chunk_cache: dict[tuple[int, int], jnp.ndarray] = {}
         #: telemetry of the most recent :meth:`query` call
         self.last_stats: dict = {}
+
+    def clear_cache(self) -> None:
+        """Drop every device-resident cached chunk (frees device memory;
+        the next campaign re-streams from disk)."""
+        self._chunk_cache.clear()
+
+    def _cache_get(self, key):
+        hit = self._chunk_cache.pop(key, None)
+        if hit is not None:
+            self._chunk_cache[key] = hit          # refresh recency
+        return hit
+
+    def _cache_put(self, key, fm_d: jnp.ndarray) -> None:
+        if self.cache_bytes <= 0 or fm_d.nbytes > self.cache_bytes:
+            return
+        held = sum(v.nbytes for v in self._chunk_cache.values())
+        while self._chunk_cache and held + fm_d.nbytes > self.cache_bytes:
+            old = self._chunk_cache.pop(
+                next(iter(self._chunk_cache)))    # evict least-recent
+            held -= old.nbytes
+        self._chunk_cache[key] = fm_d
 
     def _block(self, wid: int, bid: int) -> np.ndarray:
         """Memory-mapped block file (cached handle, not cached data)."""
@@ -184,6 +246,8 @@ class StreamedCPDOracle:
         out_p = np.zeros(nq, np.int64)
         out_f = np.zeros(nq, bool)
         bytes_streamed = 0
+        cache_hits = 0
+        cache_misses = 0
         # one sort up front; each chunk's queries are then a slice (the
         # serving hot path must not rescan all Q queries per chunk)
         q_by_chunk = np.argsort(q_chunk, kind="stable")
@@ -197,18 +261,37 @@ class StreamedCPDOracle:
 
         def prep(ci):
             """Host read + padding + device upload (async enqueue) for
-            one chunk."""
+            one chunk; chunks come from / land in the device LRU so
+            overlapping campaigns skip the upload. Range chunks key on
+            their row range; compacted chunks (arbitrary row sets) are
+            content-addressed by the row-id digest, so only an identical
+            chunk repeats — e.g. a replayed or per-diff-round campaign."""
+            nonlocal bytes_streamed, cache_hits, cache_misses
             if range_mode:
-                fm_np = self._row_range(int(wid_of_chunk[ci]),
-                                        int(r0_of_chunk[ci]), c)
+                wid_c, r0_c = int(wid_of_chunk[ci]), int(r0_of_chunk[ci])
+                key = (wid_c, r0_c, c)
             else:
                 take = u_order[ci * c:(ci + 1) * c]
-                fm_np = self._gather_rows(u_wid[take], u_row[take])
-                if len(take) < c:             # stable chunk shape: pad
-                    fm_np = np.concatenate(   # with stuck rows
-                        [fm_np, np.full((c - len(take), self.graph.n),
-                                        -1, np.int8)])
-            nbytes = fm_np.nbytes
+                key = ("compacted", c,
+                       hashlib.blake2b(u_wid[take].tobytes()
+                                       + u_row[take].tobytes(),
+                                       digest_size=16).digest())
+            fm_dev = self._cache_get(key)
+            if fm_dev is not None:
+                cache_hits += 1
+            else:
+                cache_misses += 1
+                if range_mode:
+                    fm_np = self._row_range(wid_c, r0_c, c)
+                else:
+                    fm_np = self._gather_rows(u_wid[take], u_row[take])
+                    if len(take) < c:         # stable chunk shape: pad
+                        fm_np = np.concatenate(  # with stuck rows
+                            [fm_np, np.full((c - len(take), self.graph.n),
+                                            -1, np.int8)])
+                fm_dev = jnp.asarray(fm_np)
+                bytes_streamed += fm_np.nbytes
+                self._cache_put(key, fm_dev)
             lo, hi = bounds[ci], bounds[ci + 1]
             q_idx = q_by_chunk[lo:hi]
             # order by expected walk length so the kernel's bucketed
@@ -223,9 +306,9 @@ class StreamedCPDOracle:
             s_l[:len(q_idx)] = s_all[q_idx]
             t_l[:len(q_idx)] = t_all[q_idx]
             valid[:len(q_idx)] = True
-            dev = [jnp.asarray(a)
-                   for a in (fm_np, rows_l, s_l, t_l, valid)]
-            return dev, q_idx, nbytes
+            dev = [fm_dev] + [jnp.asarray(a)
+                              for a in (rows_l, s_l, t_l, valid)]
+            return dev, q_idx
 
         # The pipeline is the XLA stream itself: uploads and walk
         # dispatches only ENQUEUE (async), so while the device DMAs and
@@ -249,8 +332,7 @@ class StreamedCPDOracle:
 
         pending = []          # (q_idx, device result triple) per chunk
         for ci in range(n_chunks):
-            (fm_d, rows_d, s_d, t_d, v_d), q_idx, nbytes = prep(ci)
-            bytes_streamed += nbytes
+            (fm_d, rows_d, s_d, t_d, v_d), q_idx = prep(ci)
             outs = table_search_batch(
                 self.dg, fm_d, rows_d, s_d, t_d, w_pad,
                 valid=v_d, k_moves=k_moves, max_steps=max_steps)
@@ -266,6 +348,8 @@ class StreamedCPDOracle:
             "distinct_targets": int(len(uniq_t)),
             "row_chunks": n_chunks,
             "bytes_streamed": int(bytes_streamed),
+            "cache_hits": cache_hits,
+            "cache_misses": cache_misses,
             "mode": "range" if range_mode else "compacted",
         }
         return out_c, out_p, out_f

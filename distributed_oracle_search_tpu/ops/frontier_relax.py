@@ -172,7 +172,14 @@ def _frontier_dist_fn(n: int, f: int, delta: int, s_unroll: int,
         def body(st):
             i, dist, prio = st
             theta = prio.min() + delta
-            idx = jnp.nonzero(prio <= theta, size=f, fill_value=n)[0]
+            # idle nodes (prio == JINF) must never match the pop window:
+            # when theta >= JINF (near-INF weights push prio.min() within
+            # delta of JINF), an unmasked pop fills the f slots with
+            # low-id idle nodes and starves armed nodes forever —
+            # a livelock until the iteration backstop. No overflow:
+            # prio <= JINF (1e9) and delta <= 2^29, sum < int32 max.
+            idx = jnp.nonzero((prio <= theta) & (prio < JINF),
+                              size=f, fill_value=n)[0]
             live = idx < n
             prio = prio.at[idx].set(JINF)             # pads dropped
             nbr = out_nbr[idx]                        # [F, K] (pads clip)

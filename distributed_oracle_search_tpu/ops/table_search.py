@@ -29,12 +29,15 @@ import jax.numpy as jnp
 from .device_graph import DeviceGraph
 
 
-#: auto-bucketing: target lanes per bucket / bucket-count cap. ~1-2k
-#: lanes keep the gather pipeline saturated on v5e; 32 buckets bound the
-#: per-bucket while_loop dispatch overhead (swept end-to-end on the 50k
-#: bench: 32/1024 ≥ 16/2048 > 8/4096)
+#: auto-bucketing: target lanes per bucket / bucket-count cap. ~1k lanes
+#: keep the gather pipeline busy on v5e while letting each bucket's
+#: while_loop exit at its own max length; 64 buckets bound the per-bucket
+#: dispatch overhead (swept end-to-end on the 50k bench across rounds:
+#: 64/1024 > 32/2048 > 16/4096 with the lean step — narrower buckets hug
+#: the est-sorted length profile, and the per-iteration floor, not lane
+#: width, is the binding cost at this size)
 BUCKET_LANES = 1024
-BUCKET_MAX = 32
+BUCKET_MAX = 64
 
 
 def pick_buckets(q: int, n_buckets: int = 0) -> int:
@@ -90,9 +93,19 @@ def table_search_batch(dg: DeviceGraph, fm: jnp.ndarray,
     """
     q = s.shape[0]
     n = dg.n
+    r = fm.shape[0]
     limit = n if max_steps == 0 else max_steps
-    budget = jnp.where(jnp.asarray(k_moves) < 0, jnp.int32(limit),
-                       jnp.asarray(k_moves).astype(jnp.int32))
+    # static specialization: the common serving call passes the Python
+    # literal -1 (unlimited, the reference default) with max_steps=0 —
+    # then the per-step budget compare vanishes from the compiled
+    # program entirely (safe: a CPD walk follows a simple path, so it
+    # reaches its target or a -1 slot in < N moves; only an explicit
+    # max_steps truncation needs the exact per-step plen cap)
+    unlimited = (isinstance(k_moves, int) and k_moves < 0
+                 and max_steps == 0)
+    if not unlimited:
+        budget = jnp.where(jnp.asarray(k_moves) < 0, jnp.int32(limit),
+                           jnp.asarray(k_moves).astype(jnp.int32))
     if valid is None:
         valid = jnp.ones((q,), jnp.bool_)
     n_buckets = pick_buckets(q, n_buckets)
@@ -110,42 +123,58 @@ def table_search_batch(dg: DeviceGraph, fm: jnp.ndarray,
     pair = jnp.stack([dg.out_nbr.astype(jnp.int32),
                       w_query_pad[dg.out_eid]], axis=-1)
 
+    # flattened fm for a 1-D gather per step (measured ~7% over the
+    # (row, col) 2-D form); falls back to 2-D when R * N would overflow
+    # the int32 flat index (large sharded tables)
+    flat = r * n < (1 << 31)
+    fm_flat = fm.reshape(-1) if flat else fm
+
+    def slot_at(rows_b, base, x):
+        if flat:
+            return fm_flat[base + x].astype(jnp.int32)
+        return fm[rows_b, x].astype(jnp.int32)
+
     def walk_bucket(rows_b, s_b, t_b, valid_b):
         x0 = jnp.where(valid_b, s_b, t_b)
-        done0 = x0 == t_b
-        # cost/plen start from x0 * 0 (not a fresh constant) so that,
-        # under shard_map, the carry inherits the inputs' mesh-varying
-        # type
-        state0 = (jnp.int32(0), x0, x0 * 0, x0 * 0, done0, done0)
+        base = rows_b * n if flat else rows_b
+        # the walk needs NO per-step arrival check: every fm row holds
+        # -1 at its own target (first_move_from_dist construction, the
+        # reference's "no move at the goal"), so arriving lanes halt on
+        # the stuck test and `finished` is recovered at the end as
+        # x == t. Dropping the finished carry and (when `unlimited`)
+        # the budget compare leaves 2 gathers + 1 compare + 4 selects
+        # per step. halted0 derives from the DATA (not a literal) so
+        # the carry stays mesh-varying under shard_map; pad lanes are
+        # halted at birth or a mostly-pad tail bucket would walk row
+        # 0's full path before its while_loop could exit
+        halted0 = (slot_at(rows_b, base, x0) < 0) | ~valid_b
+        state0 = (jnp.int32(0), x0, x0 * 0, x0 * 0, halted0)
 
         def cond(state):
-            i, _, _, _, _, halted = state
+            i, _, _, _, halted = state
             return (~jnp.all(halted)) & (i < limit)
 
-        def step(x, cost, plen, finished, halted):
-            # 2-D gather (row, col) rather than a flattened index: R * N
-            # can exceed int32 range on large sharded tables
-            slot = fm[rows_b, x].astype(jnp.int32)
-            can_move = (~halted) & (slot >= 0) & (plen < budget)
+        def step(x, cost, plen, halted):
+            slot = slot_at(rows_b, base, x)
+            can_move = (~halted) & (slot >= 0)
+            if not unlimited:
+                can_move &= plen < budget
             slot_safe = jnp.maximum(slot, 0)
             nxt_w = pair[x, slot_safe]          # [Q, 2] one gather
             cost = jnp.where(can_move, cost + nxt_w[:, 1], cost)
             plen = jnp.where(can_move, plen + 1, plen)
             x = jnp.where(can_move, nxt_w[:, 0], x)
-            finished = finished | (x == t_b)
-            halted = halted | finished | ~can_move
-            return x, cost, plen, finished, halted
+            halted = halted | ~can_move
+            return x, cost, plen, halted
 
         def body(state):
-            i, x, cost, plen, finished, halted = state
+            i, x, cost, plen, halted = state
             for _ in range(unroll):
-                x, cost, plen, finished, halted = step(
-                    x, cost, plen, finished, halted)
-            return i + unroll, x, cost, plen, finished, halted
+                x, cost, plen, halted = step(x, cost, plen, halted)
+            return i + unroll, x, cost, plen, halted
 
-        _, x, cost, plen, finished, _ = jax.lax.while_loop(
-            cond, body, state0)
-        return cost, plen, finished
+        _, x, cost, plen, _ = jax.lax.while_loop(cond, body, state0)
+        return cost, plen, x == t_b
 
     if n_buckets == 1:
         cost, plen, finished = walk_bucket(rows32, s.astype(jnp.int32),

@@ -333,11 +333,15 @@ def query_dist_sharded(dist_wrn: jax.Array, t_rows: np.ndarray,
 
 
 @functools.lru_cache(maxsize=None)
-def _query_fn(mesh: Mesh, max_steps: int):
+def _query_fn(mesh: Mesh, max_steps: int, static_unlimited: bool = False):
     q3 = P(DATA_AXIS, WORKER_AXIS, None)
 
-    def _local(dg, fm_local, rows, s, t, valid, w_pad, k_moves):
-        # local blocks: fm [1, R, N]; queries [D/|data|, 1, Q]
+    def _local(dg, fm_local, rows, s, t, valid, w_pad, *k_ops):
+        # local blocks: fm [1, R, N]; queries [D/|data|, 1, Q].
+        # static_unlimited passes the PYTHON -1 through, so the kernel's
+        # static no-budget specialization applies (a traced k_moves
+        # operand would force the per-step budget compare back in)
+        k_moves = -1 if static_unlimited else k_ops[0]
         fm2 = fm_local[0]
         shape = s.shape
         cost, plen, fin = table_search_batch(
@@ -347,7 +351,8 @@ def _query_fn(mesh: Mesh, max_steps: int):
 
     sm = jax.shard_map(
         _local, mesh=mesh,
-        in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, P(), P()),
+        in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, P())
+        + (() if static_unlimited else (P(),)),
         out_specs=(q3, q3, q3),
     )
     return jax.jit(sm)
@@ -369,6 +374,8 @@ def query_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
     # and never jnp.asarray first — that is a second, default-device
     # transfer before the resharding copy
     args = jax.device_put((t_rows, s, t, valid), qs)
-    fn = _query_fn(mesh, max_steps)
-    return fn(dg, fm_wrn, *args, jnp.asarray(w_query_pad),
-              jnp.int32(k_moves))
+    static_unlimited = (isinstance(k_moves, int) and k_moves < 0
+                        and max_steps == 0)
+    fn = _query_fn(mesh, max_steps, static_unlimited)
+    extra = () if static_unlimited else (jnp.int32(k_moves),)
+    return fn(dg, fm_wrn, *args, jnp.asarray(w_query_pad), *extra)

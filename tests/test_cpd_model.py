@@ -297,6 +297,33 @@ def test_frontier_build_matches_plain_ell(toy_graph):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_frontier_near_inf_weights_terminate(toy_graph):
+    """Regression: weights large enough that theta = prio.min() + delta
+    crosses JINF must not pop idle (prio == JINF) nodes — an unmasked
+    pop starved armed high-id nodes forever (livelock to the iteration
+    backstop). Legal inputs: dimacs accepts any weight < 1e9."""
+    import jax.numpy as jnp
+
+    from distributed_oracle_search_tpu.data.graph import Graph
+    from distributed_oracle_search_tpu.ops import (
+        DeviceGraph, build_fm_columns,
+    )
+    from distributed_oracle_search_tpu.ops.frontier_relax import (
+        build_fm_columns_frontier, frontier_graph,
+    )
+
+    g0 = toy_graph
+    g = Graph(g0.xs, g0.ys, g0.src, g0.dst,
+              np.full(g0.m, 500_000_000, np.int32))
+    dg = DeviceGraph.from_graph(g)
+    fg = frontier_graph(g)        # pick_delta clamps delta to 2^29
+    assert fg.delta == 1 << 29
+    tgts = np.arange(0, g.n, 2, dtype=np.int32)
+    ref = np.asarray(build_fm_columns(dg, jnp.asarray(tgts)))
+    got = np.asarray(build_fm_columns_frontier(dg, fg, tgts))
+    np.testing.assert_array_equal(got, ref)
+
+
 def test_frontier_auto_gate():
     """auto picks the frontier queue only for big graphs whose ids have
     locality (post-RCM road nets); shuffled ids of the SAME graph fall

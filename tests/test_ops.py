@@ -157,7 +157,7 @@ def test_bucketed_walk_invariant(toy_graph, dg, toy_queries):
                 np.testing.assert_array_equal(np.asarray(a), np.asarray(r))
     # odd sizes fall back to a divisor (257 is prime -> 1 bucket)
     assert pick_buckets(257, 0) == 1
-    assert pick_buckets(65536, 0) == 32
+    assert pick_buckets(65536, 0) == 64
     assert pick_buckets(8192, 0) == 8
     assert pick_buckets(100, 6) == 5
 

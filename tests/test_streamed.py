@@ -77,6 +77,63 @@ def test_streamed_rejects_mismatched_controller(stream_setup):
         StreamedCPDOracle(g, other, outdir)
 
 
+def test_streamed_chunk_cache_round2_streams_zero(stream_setup,
+                                                  monkeypatch):
+    """The device LRU makes round 2 of an overlapping campaign stream
+    ZERO bytes, and a diff round reuses the SAME chunks (fm rows hold
+    free-flow moves; diffs only change cost accumulation)."""
+    g, dc, outdir, queries, resident = stream_setup
+    monkeypatch.setenv("DOS_STREAM_RANGE_DENSITY", "0.0")   # force range
+    st = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
+    c1, p1, f1 = st.query(queries)
+    assert st.last_stats["cache_misses"] == st.last_stats["row_chunks"]
+    assert st.last_stats["bytes_streamed"] > 0
+    c2, p2, f2 = st.query(queries)
+    assert st.last_stats["bytes_streamed"] == 0
+    assert st.last_stats["cache_hits"] == st.last_stats["row_chunks"]
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_array_equal(f1, f2)
+    w_diff = g.weights_with_diff(synth_diff(g, frac=0.2, seed=9))
+    c_d, p_d, f_d = st.query(queries, w_query=w_diff)
+    assert st.last_stats["bytes_streamed"] == 0    # diff round: all hits
+    c_r, p_r, f_r = resident.query(queries, w_query=w_diff)
+    np.testing.assert_array_equal(c_d, c_r)
+    np.testing.assert_array_equal(p_d, p_r)
+    np.testing.assert_array_equal(f_d, f_r)
+    # compacted mode: identical replayed campaign is content-addressed
+    monkeypatch.setenv("DOS_STREAM_RANGE_DENSITY", "2.0")
+    st_c = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
+    c_c1, _, _ = st_c.query(queries)
+    assert st_c.last_stats["mode"] == "compacted"
+    assert st_c.last_stats["bytes_streamed"] > 0
+    c_c2, _, _ = st_c.query(queries)
+    assert st_c.last_stats["bytes_streamed"] == 0
+    np.testing.assert_array_equal(c_c1, c_c2)
+
+
+def test_streamed_cache_budget_and_disable(stream_setup, monkeypatch):
+    """Residency never exceeds cache_bytes (LRU evicts); 0 disables."""
+    g, dc, outdir, queries, resident = stream_setup
+    monkeypatch.setenv("DOS_STREAM_RANGE_DENSITY", "0.0")   # force range
+    two_chunks = 2 * 37 * g.n
+    st = StreamedCPDOracle(g, dc, outdir, row_chunk=37,
+                           cache_bytes=two_chunks)
+    c_s, p_s, f_s = st.query(queries)
+    assert st.last_stats["row_chunks"] > 2         # forced eviction
+    held = sum(v.nbytes for v in st._chunk_cache.values())
+    assert 0 < held <= two_chunks
+    c_r, p_r, f_r = resident.query(queries)
+    np.testing.assert_array_equal(c_s, c_r)
+
+    st0 = StreamedCPDOracle(g, dc, outdir, row_chunk=37, cache_bytes=0)
+    st0.query(queries)
+    c0, p0, f0 = st0.query(queries)
+    assert st0.last_stats["cache_hits"] == 0
+    assert st0.last_stats["bytes_streamed"] > 0
+    np.testing.assert_array_equal(c0, c_r)
+
+
 def test_streamed_modes_agree(stream_setup, monkeypatch):
     """Range and compacted chunking must produce identical answers."""
     g, dc, outdir, queries, resident = stream_setup
