@@ -45,8 +45,10 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 
@@ -101,8 +103,6 @@ def _native_bins():
     denominator the north-star speedups are judged against (reference
     README.md:88-95: baselines must be produced by running the pipeline,
     not copied)."""
-    import shutil
-
     if shutil.which("g++") is None or shutil.which("make") is None:
         return None
     here = os.path.dirname(os.path.abspath(__file__))
@@ -409,9 +409,6 @@ def main() -> None:
         if bins is None:
             log("CPU baseline skipped: no native toolchain")
         else:
-            import shutil
-            import tempfile
-
             cdir = tempfile.mkdtemp(prefix="dos-cpu-")
             try:
                 xy = os.path.join(cdir, "city.xy")
@@ -573,9 +570,6 @@ def main() -> None:
     # that exceed HBM (full fm at this scale: N^2 = 10.5 GB single-shard).
     scale_stats = {}
     if os.environ.get("BENCH_SCALE", "1") != "0":
-        import shutil
-        import tempfile
-
         from distributed_oracle_search_tpu.models.cpd import (
             build_worker_shard, write_index_manifest,
         )
@@ -661,11 +655,12 @@ def main() -> None:
                 "scale_build_seconds": round(t_b2.interval, 2),
                 "scale_build_rows_per_sec": round(rps2, 1),
                 "scale_full_build_est_seconds": round(full_est, 1),
-                # steady-state rate; the first-ever round is the _cold_
-                # fields (pays the full index upload once per process)
-                "scale_stream_queries_per_sec": round(warm_qps, 1),
-                "scale_stream_cold_queries_per_sec": round(cold_qps, 1),
-                "scale_stream_cold_mb": round(cold_mb, 1),
+                # cold keeps the r03 key (rounds stay comparable across
+                # bench artifacts); the cache-warm steady state is its
+                # own key, never a silent redefinition
+                "scale_stream_queries_per_sec": round(cold_qps, 1),
+                "scale_stream_mb": round(cold_mb, 1),
+                "scale_stream_warm_queries_per_sec": round(warm_qps, 1),
                 "scale_stream_warm_mb": 0.0,
             }
 
@@ -753,9 +748,9 @@ def main() -> None:
                         "scale_build_parity_cores": round(
                             rps2 / cpu_rps2 * cores, 2),
                         "scale_tpu_stream_speedup": round(
-                            t_cpu_q2 / t_q2w.interval, 3),
-                        "scale_tpu_stream_cold_speedup": round(
                             t_cpu_q2 / t_q2.interval, 3),
+                        "scale_tpu_stream_warm_speedup": round(
+                            t_cpu_q2 / t_q2w.interval, 3),
                         "scale_tpu_resident_speedup": round(
                             t_cpu_q2 / t_res.interval, 3),
                     })
@@ -769,9 +764,6 @@ def main() -> None:
     # resident from the same index. BENCH_ROAD=0 skips.
     road_stats = {}
     if os.environ.get("BENCH_ROAD", "1") != "0":
-        import shutil
-        import tempfile
-
         import jax.numpy as jnp
 
         from distributed_oracle_search_tpu.data import synth_road_network
@@ -931,8 +923,13 @@ def main() -> None:
                 w_diff3 = g3.weights_with_diff((dsrc3, ddst3, dw3))
                 diff3 = os.path.join(out3, "road.xy.diff")
                 write_diff(diff3, dsrc3, ddst3, dw3)
-                with Timer() as t_qd3:   # streamed: chunks already cached
-                    cd3, pd3, fd3 = st3.query(q3, w_query=w_diff3)
+                # streamed diff round: chunks already cached; best_of
+                # like every other serve figure (single-shot timings
+                # carry the ±20% link jitter). The per-call diff-weight
+                # upload stays inside the timer — it IS part of serving
+                # a diff round.
+                (cd3, pd3, fd3), t_qd3 = best_of(
+                    lambda: st3.query(q3, w_query=w_diff3))
                 assert bool(fd3.all())
                 assert st3.last_stats["bytes_streamed"] == 0, \
                     "diff round must reuse the free-flow round's chunks"
@@ -967,9 +964,9 @@ def main() -> None:
                     "road_build_parity_cores": round(
                         tpu_rps3 / cpu_rps3 * cores, 2),
                     "road_stream_queries_per_sec": round(
-                        rq / t_q3w.interval, 1),
-                    "road_stream_cold_queries_per_sec": round(
                         rq / t_q3.interval, 1),
+                    "road_stream_warm_queries_per_sec": round(
+                        rq / t_q3w.interval, 1),
                     "road_resident_queries_per_sec": round(rqps3, 1),
                     "road_cpu_queries_per_sec": round(rq / t_cq3, 1),
                     "road_tpu_resident_speedup": round(
@@ -1018,9 +1015,6 @@ def main() -> None:
     # This is the positive multi-device evidence available without
     # multi-chip hardware.
     if os.environ.get("BENCH_WEAK", "1") != "0":
-        import shutil
-        import tempfile
-
         from distributed_oracle_search_tpu.models.cpd import (
             build_worker_shard,
         )

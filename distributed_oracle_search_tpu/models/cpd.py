@@ -567,10 +567,12 @@ class CPDOracle:
         amortization path for huge campaigns, including congestion-diffed
         rounds where :meth:`query_dist` does not apply.
 
-        **Measured trade (BENCH_r03, 9216-node shard, v5e):** prepare
-        18.8 s, lookups ~400-520k q/s vs the ~200-280k q/s walk →
-        break-even at ~7M queries per diff round. Memory: 6-8 bytes/entry = 6-8x the
-        fm shard; calls whose tables exceed the per-device budget
+        **Measured trade (BENCH_r04 capture, 9216-node shard, v5e):**
+        prepare ~19 s, lookups ~516k q/s vs the ~306k q/s diffed walk →
+        break-even at ~14M queries per diff round (the bench recomputes
+        ``table_breakeven_queries`` from each run's own timings; the
+        tunneled link swings runs ±20%). Memory: 6-8 bytes/entry = 6-8x
+        the fm shard; calls whose tables exceed the per-device budget
         (``DOS_TABLE_BUDGET_GB``, default 8) raise with the math instead
         of faulting mid-campaign.
 
@@ -597,7 +599,8 @@ class CPDOracle:
                 f"over the {budget / 1e9:.1f} GB/device budget "
                 "(DOS_TABLE_BUDGET_GB). At this scale serve via the walk "
                 "or StreamedCPDOracle instead; the table trade only pays "
-                "past ~7M queries per diff round anyway.")
+                "past ~14M queries per diff round anyway (measured "
+                "break-even, bench table_breakeven_queries).")
         w_pad = (self.dg.w_pad if w_query is None
                  else jnp.asarray(self.graph.padded_weights(w_query),
                                   jnp.int32))

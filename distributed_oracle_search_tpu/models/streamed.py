@@ -316,9 +316,12 @@ class StreamedCPDOracle:
         # explicit prefetch thread (concurrent host threads were measured
         # to degrade transfer bandwidth ~5x over a tunneled device link,
         # and buy nothing that the async stream does not already give).
-        #: in-flight chunks (inputs AND outputs) kept on device at once —
-        #: bounds device memory regardless of campaign size; draining the
-        #: oldest chunk early also frees its fm buffer
+        #: in-flight chunks (inputs AND outputs) kept on device at once.
+        #: Device residency is bounded by DEPTH in-flight chunks PLUS up
+        #: to ``cache_bytes`` of LRU-cached fm chunks (cached chunks are
+        #: NOT freed on drain — that is the point of the cache); size
+        #: ``cache_bytes`` accordingly, or 0 to get pure
+        #: DEPTH-bounded streaming back
         DEPTH = 4
 
         def drain(entries):

@@ -124,12 +124,18 @@ def locality_fraction(graph, window_mult: int = 8) -> float:
 
 def frontier_graph(graph, f: int | None = None, delta: int | None = None,
                    s_unroll: int = 2) -> FrontierGraph:
-    """Build the bundle from a :class:`~..data.graph.Graph`."""
+    """Build the bundle from a :class:`~..data.graph.Graph`.
+
+    An explicit ``delta`` is clamped to ``pick_delta``'s 2^29 ceiling:
+    the pop window computes ``prio.min() + delta`` in int32, and an
+    unclamped width would overflow it negative — an empty pop window
+    that live-locks the build loop."""
     in_nbr, _ = graph.ell("in")
     return FrontierGraph(
         in_nbr=np.asarray(in_nbr, np.int32), n=graph.n,
         f=f if f is not None else FRONTIER_CAPACITY,
-        delta=delta if delta is not None else pick_delta(graph.w),
+        delta=(min(int(delta), 1 << 29) if delta is not None
+               else pick_delta(graph.w)),
         s_unroll=s_unroll)
 
 

@@ -17,16 +17,20 @@ converges in ceil(log2 L) sweeps to the TOTAL cost from every node to
 every owned target — after which any (s, t) query is ONE gather, on diffed
 weights too (the walk's only advantage was laziness).
 
-Cost model — MEASURED, not aspirational (bench graph 9216x9216, v5e,
-BENCH_r03): one sweep is ONE packed dependent ``[R, N]`` gather (succ, cost,
-plen as 12 adjacent bytes) — **18.8 s** prepare for the full shard,
-then lookups at ~400-520k q/s vs the ~200-280k q/s walk. Break-even on
-those numbers: a diff round must answer ~**7M queries**
-(``prepare / (1/walk_qps − 1/lookup_qps)``) before the tables pay for
-themselves — the regime of BASELINE.md configs[4]'s 10M-query DIMACS
-campaign, not of small scenarios. Memory: cost int32 + sign-packed plen
-(int16 when ``N < 32768``) = 6-8 bytes per entry = **6-8x the fm shard**;
-``models.cpd.prepare_weights`` enforces a budget gate before allocating.
+Cost model — MEASURED, not aspirational, and regenerated every bench run
+(bench graph 9216x9216, v5e, captured in the driver's BENCH artifacts —
+the ``table_breakeven_queries`` field is computed from the same run's
+prepare/walk/lookup timings, never quoted from memory): one sweep is ONE
+packed dependent ``[R, N]`` gather (succ, cost, plen as 12 adjacent
+bytes) — ~**19 s** prepare for the full shard, then lookups at ~516k q/s
+vs the ~306k q/s diffed walk (r04 capture; the tunneled link swings
+individual runs ±20%). Break-even on those numbers: a diff round must
+answer ~**14M queries** (``prepare / (1/walk_qps − 1/lookup_qps)``)
+before the tables pay for themselves — the regime of BASELINE.md
+configs[4]'s 10M-query DIMACS campaign, not of small scenarios. Memory:
+cost int32 + sign-packed plen (int16 when ``N < 32768``) = 6-8 bytes per
+entry = **6-8x the fm shard**; ``models.cpd.prepare_weights`` enforces a
+budget gate before allocating.
 Self-loops make the recursion total: the target itself and stuck
 (unreachable) nodes point at themselves with step cost 0, so their
 accumulated cost is exactly the walk's cost-until-stuck.
