@@ -952,6 +952,43 @@ def main() -> None:
                     f"{t_cqd3:.3f}s -> {rq / t_cqd3:,.0f} q/s (tpu "
                     f"resident {t_cqd3 / t_rd3.interval:.2f}x)")
 
+                # fused multi-diff: D congestion rounds in ONE walk
+                # (trajectories are diff-independent — the reference
+                # must run D sequential rounds, process_query.py:178).
+                # All weight rows are pre-uploaded for BOTH paths so
+                # the comparison times walks, not today's uplink.
+                from distributed_oracle_search_tpu.ops.table_search \
+                    import table_search_multi
+                n_rounds = 4
+                w4 = [g3.weights_with_diff(synth_diff(
+                          g3, frac=0.1, seed=70 + i))
+                      for i in range(n_rounds)]
+                w4_seq = [jnp.asarray(g3.padded_weights(w), jnp.int32)
+                          for w in w4]
+                w4_pads = jnp.asarray(
+                    np.stack([g3.padded_weights(w) for w in w4]),
+                    jnp.int32)
+
+                def seq_rounds():
+                    return [jax.block_until_ready(table_search_batch(
+                        dg3, fm0r, rr3, ss3, tt3, wd, valid=vv3))
+                        for wd in w4_seq]
+
+                def fused_rounds():
+                    return jax.block_until_ready(table_search_multi(
+                        dg3, fm0r, rr3, ss3, tt3, w4_pads, valid=vv3))
+
+                seq_out, t_seq4 = best_of(seq_rounds)
+                (cm4, pm4, fm4), t_fus4 = best_of(fused_rounds)
+                for di, (cs, ps, fs) in enumerate(seq_out):
+                    assert (np.asarray(cm4[di]) == np.asarray(cs)).all(), \
+                        f"fused round {di} != sequential round"
+                log(f"road multi-diff: {n_rounds} rounds fused in "
+                    f"{t_fus4} vs sequential {t_seq4} "
+                    f"({t_seq4.interval / t_fus4.interval:.2f}x; "
+                    f"{n_rounds * rq / t_fus4.interval:,.0f} "
+                    f"answers/s fused)")
+
                 cores = os.cpu_count() or 1
                 road_stats = {
                     "road_nodes": g3.n,
@@ -979,6 +1016,13 @@ def main() -> None:
                         rq / t_cqd3, 1),
                     "road_diff_tpu_resident_speedup": round(
                         t_cqd3 / t_rd3.interval, 3),
+                    "road_multidiff_rounds": n_rounds,
+                    "road_multidiff_fused_seconds": round(
+                        t_fus4.interval, 3),
+                    "road_multidiff_sequential_seconds": round(
+                        t_seq4.interval, 3),
+                    "road_multidiff_fused_speedup": round(
+                        t_seq4.interval / t_fus4.interval, 3),
                 }
         finally:
             shutil.rmtree(out3, ignore_errors=True)

@@ -40,8 +40,8 @@ from ..parallel.mesh import (
 from ..parallel.partition import DistributionController
 from ..parallel.sharded import (
     build_tables_sharded, pad_targets, build_fm_sharded,
-    query_dist_sharded, query_paths_sharded, query_sharded,
-    query_tables_sharded,
+    query_dist_sharded, query_multi_sharded, query_paths_sharded,
+    query_sharded, query_tables_sharded,
 )
 
 INDEX_VERSION = 1
@@ -536,6 +536,46 @@ class CPDOracle:
         out_f[active] = fin[sd[active], sw[active], sq[active]]
         return out_c, out_p, out_f
 
+    def query_multi(self, queries: np.ndarray,
+                    w_diffs: list[np.ndarray | None],
+                    active_worker: int = -1, max_steps: int = 0):
+        """Answer queries under D congestion diffs in ONE fused walk.
+
+        The reference campaign runs one round per diff file over the
+        same scenario (``process_query.py:178``), re-walking every query
+        each round. Trajectories are diff-independent (moves follow the
+        free-flow table; diffs only change cost accumulation), so the
+        fused kernel walks once and accumulates every diff's costs —
+        ~2D/3 fewer gathers than D sequential rounds
+        (:func:`~..ops.table_search.table_search_multi`).
+
+        ``w_diffs``: list of per-diff edge-weight arrays (file order);
+        ``None`` entries mean free flow. Returns ``(cost [D, Q],
+        plen [Q], finished [Q])`` in input query order.
+        """
+        if self.fm is None:
+            raise RuntimeError("build() or load() before query_multi()")
+        if not w_diffs:
+            raise ValueError("w_diffs must name at least one round")
+        r_arr, s_arr, t_arr, valid, scatter = self.route(
+            queries, active_worker)
+        w_pads = np.stack([
+            np.asarray(self.graph.padded_weights(
+                self.graph.w if w is None else w), np.int32)
+            for w in w_diffs])
+        cost, plen, fin = _host_tree(query_multi_sharded(
+            self.dg, self.fm, r_arr, s_arr, t_arr, valid, w_pads,
+            self.mesh, max_steps=max_steps))
+        nq = len(queries)
+        active, sd, sw, sq = scatter
+        out_c = np.zeros((len(w_diffs), nq), np.int64)
+        out_p = np.zeros(nq, np.int64)
+        out_f = np.zeros(nq, bool)
+        out_c[:, active] = cost[:, sd[active], sw[active], sq[active]]
+        out_p[active] = plen[sd[active], sw[active], sq[active]]
+        out_f[active] = fin[sd[active], sw[active], sq[active]]
+        return out_c, out_p, out_f
+
     # ------------------------------------------------- prepared tables
     def table_memory_bytes(self) -> int:
         """Device bytes the prepared tables will occupy: int32 cost +
@@ -568,11 +608,12 @@ class CPDOracle:
         rounds where :meth:`query_dist` does not apply.
 
         **Measured trade (BENCH_r04 capture, 9216-node shard, v5e):**
-        prepare ~19 s, lookups ~516k q/s vs the ~306k q/s diffed walk →
-        break-even at ~14M queries per diff round (the bench recomputes
-        ``table_breakeven_queries`` from each run's own timings; the
-        tunneled link swings runs ±20%). Memory: 6-8 bytes/entry = 6-8x
-        the fm shard; calls whose tables exceed the per-device budget
+        prepare ~19 s, lookups ~356k q/s vs the ~265k q/s diffed walk →
+        break-even at ~19M queries per diff round (the bench recomputes
+        ``table_breakeven_queries`` from each run's own timings;
+        captures have ranged ~14-19M with the tunneled link's ±20%
+        swing). Memory: 6-8 bytes/entry = 6-8x the fm shard; calls
+        whose tables exceed the per-device budget
         (``DOS_TABLE_BUDGET_GB``, default 8) raise with the math instead
         of faulting mid-campaign.
 
@@ -599,7 +640,7 @@ class CPDOracle:
                 f"over the {budget / 1e9:.1f} GB/device budget "
                 "(DOS_TABLE_BUDGET_GB). At this scale serve via the walk "
                 "or StreamedCPDOracle instead; the table trade only pays "
-                "past ~14M queries per diff round anyway (measured "
+                "past ~15M queries per diff round anyway (measured "
                 "break-even, bench table_breakeven_queries).")
         w_pad = (self.dg.w_pad if w_query is None
                  else jnp.asarray(self.graph.padded_weights(w_query),

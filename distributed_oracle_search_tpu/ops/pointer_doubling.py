@@ -22,10 +22,11 @@ Cost model — MEASURED, not aspirational, and regenerated every bench run
 the ``table_breakeven_queries`` field is computed from the same run's
 prepare/walk/lookup timings, never quoted from memory): one sweep is ONE
 packed dependent ``[R, N]`` gather (succ, cost, plen as 12 adjacent
-bytes) — ~**19 s** prepare for the full shard, then lookups at ~516k q/s
-vs the ~306k q/s diffed walk (r04 capture; the tunneled link swings
+bytes) — ~**19 s** prepare for the full shard, then lookups at ~356k q/s
+vs the ~265k q/s diffed walk (r04 capture; the tunneled link swings
 individual runs ±20%). Break-even on those numbers: a diff round must
-answer ~**14M queries** (``prepare / (1/walk_qps − 1/lookup_qps)``)
+answer ~**19M queries** (``prepare / (1/walk_qps − 1/lookup_qps)``;
+captures have ranged ~14-19M with the link's swing)
 before the tables pay for themselves — the regime of BASELINE.md
 configs[4]'s 10M-query DIMACS campaign, not of small scenarios. Memory:
 cost int32 + sign-packed plen (int16 when ``N < 32768``) = 6-8 bytes per

@@ -52,6 +52,77 @@ def pick_buckets(q: int, n_buckets: int = 0) -> int:
     return b
 
 
+def _fm_access(fm: jnp.ndarray, r: int, n: int):
+    """``(slot_at, base_of)`` accessors: a flattened 1-D gather per step
+    (measured ~7% over the (row, col) 2-D form) when the flat index fits
+    int32; the 2-D gather otherwise (large sharded tables)."""
+    flat = r * n < (1 << 31)
+    fm_flat = fm.reshape(-1) if flat else fm
+
+    def slot_at(rows_b, base, x):
+        if flat:
+            return fm_flat[base + x].astype(jnp.int32)
+        return fm[rows_b, x].astype(jnp.int32)
+
+    def base_of(rows_b):
+        return rows_b * n if flat else rows_b
+
+    return slot_at, base_of
+
+
+def _walk_buckets(step, slot_at, base_of, cost0_of, limit, unroll,
+                  n_buckets, rows32, s32, t32, valid):
+    """Shared walk scaffold for the single- and multi-diff kernels: one
+    ``while_loop`` per bucket under one ``lax.scan``, lean state.
+
+    The walk needs NO per-step arrival check: every fm row holds -1 at
+    its own target (``first_move_from_dist`` construction, the
+    reference's "no move at the goal"), so arriving lanes halt on the
+    stuck test inside ``step`` and ``finished`` is recovered at the end
+    as ``x == t``. ``halted0`` derives from the DATA (not a literal) so
+    the carry stays mesh-varying under shard_map; pad lanes are halted
+    at birth or a mostly-pad tail bucket would walk row 0's full path
+    before its while_loop could exit.
+
+    ``step(rows_b, base, x, cost, plen, halted)`` advances one move;
+    ``cost0_of(x0)`` shapes the cost carry (``[Q]`` or ``[Q, D]``).
+    Returns ``(cost, plen, x == t)`` flattened back to the batch axis.
+    """
+    def walk_bucket(rows_b, s_b, t_b, valid_b):
+        x0 = jnp.where(valid_b, s_b, t_b)
+        base = base_of(rows_b)
+        halted0 = (slot_at(rows_b, base, x0) < 0) | ~valid_b
+        state0 = (jnp.int32(0), x0, cost0_of(x0), x0 * 0, halted0)
+
+        def cond(state):
+            i, _, _, _, halted = state
+            return (~jnp.all(halted)) & (i < limit)
+
+        def body(state):
+            i, x, cost, plen, halted = state
+            for _ in range(unroll):
+                x, cost, plen, halted = step(rows_b, base, x, cost,
+                                             plen, halted)
+            return i + unroll, x, cost, plen, halted
+
+        _, x, cost, plen, _ = jax.lax.while_loop(cond, body, state0)
+        return cost, plen, x == t_b
+
+    q = s32.shape[0]
+    if n_buckets == 1:
+        return walk_bucket(rows32, s32, t32, valid)
+    qb = q // n_buckets
+
+    def scan_body(carry, args):
+        return carry, walk_bucket(*args)
+
+    _, outs = jax.lax.scan(
+        scan_body, jnp.int32(0),
+        tuple(a.reshape(n_buckets, qb)
+              for a in (rows32, s32, t32, valid)))
+    return jax.tree.map(lambda o: o.reshape(q, *o.shape[2:]), outs)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("max_steps", "unroll", "n_buckets"))
 def table_search_batch(dg: DeviceGraph, fm: jnp.ndarray,
@@ -123,79 +194,113 @@ def table_search_batch(dg: DeviceGraph, fm: jnp.ndarray,
     pair = jnp.stack([dg.out_nbr.astype(jnp.int32),
                       w_query_pad[dg.out_eid]], axis=-1)
 
-    # flattened fm for a 1-D gather per step (measured ~7% over the
-    # (row, col) 2-D form); falls back to 2-D when R * N would overflow
-    # the int32 flat index (large sharded tables)
-    flat = r * n < (1 << 31)
-    fm_flat = fm.reshape(-1) if flat else fm
+    slot_at, base_of = _fm_access(fm, r, n)
 
-    def slot_at(rows_b, base, x):
-        if flat:
-            return fm_flat[base + x].astype(jnp.int32)
-        return fm[rows_b, x].astype(jnp.int32)
+    # lean step: 2 gathers + 1 compare + 4 selects (the budget compare
+    # only exists when not `unlimited`); see _walk_buckets for why no
+    # per-step arrival check is needed
+    def step(rows_b, base, x, cost, plen, halted):
+        slot = slot_at(rows_b, base, x)
+        can_move = (~halted) & (slot >= 0)
+        if not unlimited:
+            can_move &= plen < budget
+        nxt_w = pair[x, jnp.maximum(slot, 0)]   # [Q, 2] one gather
+        cost = jnp.where(can_move, cost + nxt_w[:, 1], cost)
+        plen = jnp.where(can_move, plen + 1, plen)
+        x = jnp.where(can_move, nxt_w[:, 0], x)
+        halted = halted | ~can_move
+        return x, cost, plen, halted
 
-    def walk_bucket(rows_b, s_b, t_b, valid_b):
-        x0 = jnp.where(valid_b, s_b, t_b)
-        base = rows_b * n if flat else rows_b
-        # the walk needs NO per-step arrival check: every fm row holds
-        # -1 at its own target (first_move_from_dist construction, the
-        # reference's "no move at the goal"), so arriving lanes halt on
-        # the stuck test and `finished` is recovered at the end as
-        # x == t. Dropping the finished carry and (when `unlimited`)
-        # the budget compare leaves 2 gathers + 1 compare + 4 selects
-        # per step. halted0 derives from the DATA (not a literal) so
-        # the carry stays mesh-varying under shard_map; pad lanes are
-        # halted at birth or a mostly-pad tail bucket would walk row
-        # 0's full path before its while_loop could exit
-        halted0 = (slot_at(rows_b, base, x0) < 0) | ~valid_b
-        state0 = (jnp.int32(0), x0, x0 * 0, x0 * 0, halted0)
-
-        def cond(state):
-            i, _, _, _, halted = state
-            return (~jnp.all(halted)) & (i < limit)
-
-        def step(x, cost, plen, halted):
-            slot = slot_at(rows_b, base, x)
-            can_move = (~halted) & (slot >= 0)
-            if not unlimited:
-                can_move &= plen < budget
-            slot_safe = jnp.maximum(slot, 0)
-            nxt_w = pair[x, slot_safe]          # [Q, 2] one gather
-            cost = jnp.where(can_move, cost + nxt_w[:, 1], cost)
-            plen = jnp.where(can_move, plen + 1, plen)
-            x = jnp.where(can_move, nxt_w[:, 0], x)
-            halted = halted | ~can_move
-            return x, cost, plen, halted
-
-        def body(state):
-            i, x, cost, plen, halted = state
-            for _ in range(unroll):
-                x, cost, plen, halted = step(x, cost, plen, halted)
-            return i + unroll, x, cost, plen, halted
-
-        _, x, cost, plen, _ = jax.lax.while_loop(cond, body, state0)
-        return cost, plen, x == t_b
-
-    if n_buckets == 1:
-        cost, plen, finished = walk_bucket(rows32, s.astype(jnp.int32),
-                                           t32, valid)
-    else:
-        qb = q // n_buckets
-
-        def scan_body(carry, args):
-            return carry, walk_bucket(*args)
-
-        _, (cost, plen, finished) = jax.lax.scan(
-            scan_body, jnp.int32(0),
-            (rows32.reshape(n_buckets, qb),
-             s.astype(jnp.int32).reshape(n_buckets, qb),
-             t32.reshape(n_buckets, qb),
-             valid.reshape(n_buckets, qb)))
-        cost = cost.reshape(q)
-        plen = plen.reshape(q)
-        finished = finished.reshape(q)
+    cost, plen, finished = _walk_buckets(
+        step, slot_at, base_of, lambda x0: x0 * 0, limit, unroll,
+        n_buckets, rows32, s.astype(jnp.int32), t32, valid)
     finished = finished & valid
     cost = jnp.where(valid, cost, 0)
+    plen = jnp.where(valid, plen, 0)
+    return cost, plen, finished
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_steps", "unroll", "n_buckets"))
+def table_search_multi(dg: DeviceGraph, fm: jnp.ndarray,
+                       t_rows: jnp.ndarray, s: jnp.ndarray, t: jnp.ndarray,
+                       w_pads: jnp.ndarray,
+                       valid: jnp.ndarray | None = None,
+                       max_steps: int = 0, unroll: int = 8,
+                       n_buckets: int = 0):
+    """Answer a batch under D congestion diffs in ONE fused walk.
+
+    The reference campaign serves one round per diff file, re-walking
+    every query each round (reference ``process_query.py:178``). But a
+    table-search trajectory is **diff-independent** — moves follow the
+    free-flow first-move table; only cost accumulation sees the
+    query-time weights (reference semantics, this module's header). So
+    one walk can accumulate all D diffs' costs at once: per step, one
+    packed (next-node, edge-id) gather drives the move and one ``[D]``
+    row gather from the transposed weight matrix accumulates every
+    diff's cost — ~3 gathers/step total instead of 2 PER DIFF for D
+    sequential rounds (≈ 2D/3 fewer gathers, bounded by the D-wide
+    row-gather's bandwidth).
+
+    Parameters as :func:`table_search_batch` except ``w_pads``: int32
+    ``[D, M+1]`` — one padded weight row per diff (row d =
+    ``graph.padded_weights(w_diff_d)``; include free flow as a row to
+    get it fused too). There is no ``k_moves``: the fused path serves
+    the unlimited reference default; budgeted campaigns fall back to
+    sequential rounds (``cli.process_query``). ``max_steps`` truncates
+    exactly like the single-diff kernel's.
+
+    Returns ``(cost [D, Q], plen [Q], finished [Q])`` — plen/finished
+    are shared across diffs because the trajectory is.
+    """
+    q = s.shape[0]
+    n = dg.n
+    r = fm.shape[0]
+    limit = n if max_steps == 0 else max_steps
+    if valid is None:
+        valid = jnp.ones((q,), jnp.bool_)
+    n_buckets = pick_buckets(q, n_buckets)
+    d = w_pads.shape[0]
+
+    t32 = t.astype(jnp.int32)
+    rows32 = t_rows.astype(jnp.int32)
+
+    # packed (next-node, edge-id) pair + [M+1, D] transposed weights:
+    # the per-step [Q, D] weight gather reads D contiguous int32s per
+    # lane, the same widening trick as the single-diff (next, w) pair
+    pair = jnp.stack([dg.out_nbr.astype(jnp.int32),
+                      dg.out_eid.astype(jnp.int32)], axis=-1)
+    w_t = w_pads.T                                   # [M+1, D]
+
+    slot_at, base_of = _fm_access(fm, r, n)
+
+    # mirror table_search_batch's truncation contract: an explicit
+    # max_steps caps plen EXACTLY per step (the while cond alone would
+    # overshoot by up to unroll-1 moves)
+    bounded = max_steps != 0
+
+    def step(rows_b, base, x, cost, plen, halted):
+        slot = slot_at(rows_b, base, x)
+        can_move = (~halted) & (slot >= 0)
+        if bounded:
+            can_move &= plen < limit
+        nxt_eid = pair[x, jnp.maximum(slot, 0)]  # [Q, 2]
+        w_row = w_t[nxt_eid[:, 1]]               # [Q, D] one gather
+        cost = jnp.where(can_move[:, None], cost + w_row, cost)
+        plen = jnp.where(can_move, plen + 1, plen)
+        x = jnp.where(can_move, nxt_eid[:, 0], x)
+        halted = halted | ~can_move
+        return x, cost, plen, halted
+
+    def cost0_of(x0):
+        return (jnp.zeros((x0.shape[0], d), jnp.int32)
+                + (x0 * 0)[:, None])
+
+    cost, plen, finished = _walk_buckets(
+        step, slot_at, base_of, cost0_of, limit, unroll,
+        n_buckets, rows32, s.astype(jnp.int32), t32, valid)
+    finished = finished & valid
+    cost = jnp.where(valid[:, None], cost, 0).T      # [D, Q]
     plen = jnp.where(valid, plen, 0)
     return cost, plen, finished
 

@@ -358,6 +358,47 @@ def _query_fn(mesh: Mesh, max_steps: int, static_unlimited: bool = False):
     return jax.jit(sm)
 
 
+@functools.lru_cache(maxsize=None)
+def _query_multi_fn(mesh: Mesh, max_steps: int, d: int):
+    from ..ops.table_search import table_search_multi
+
+    q3 = P(DATA_AXIS, WORKER_AXIS, None)
+
+    def _local(dg, fm_local, rows, s, t, valid, w_pads):
+        fm2 = fm_local[0]
+        shape = s.shape
+        cost, plen, fin = table_search_multi(
+            dg, fm2, rows.reshape(-1), s.reshape(-1), t.reshape(-1),
+            w_pads, valid=valid.reshape(-1), max_steps=max_steps)
+        return (cost.reshape(d, *shape), plen.reshape(shape),
+                fin.reshape(shape))
+
+    sm = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, P()),
+        out_specs=(P(None, DATA_AXIS, WORKER_AXIS, None), q3, q3),
+    )
+    return jax.jit(sm)
+
+
+def query_multi_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
+                        t_rows: np.ndarray, s: np.ndarray, t: np.ndarray,
+                        valid: np.ndarray, w_pads, mesh: Mesh,
+                        max_steps: int = 0):
+    """Fused multi-diff campaign on the mesh: one walk, D cost sets.
+
+    ``w_pads`` int32 [D, M+1] (one padded weight row per diff). Returns
+    ``(cost [D, Dg, W, Q], plen [Dg, W, Q], finished [Dg, W, Q])`` for
+    routed ``[Dg, W, Q]`` batches — trajectories are diff-independent,
+    so plen/finished are shared (``ops.table_search.table_search_multi``).
+    """
+    qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
+    args = jax.device_put((t_rows, s, t, valid), qs)
+    w = jnp.asarray(w_pads, jnp.int32)
+    fn = _query_multi_fn(mesh, max_steps, int(w.shape[0]))
+    return fn(dg, fm_wrn, *args, w)
+
+
 def query_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
                   t_rows: np.ndarray, s: np.ndarray, t: np.ndarray,
                   valid: np.ndarray, w_query_pad, mesh: Mesh,

@@ -59,6 +59,44 @@ def test_query_with_diff_and_kmoves(toy_graph, oracle, toy_queries):
     assert np.all(p2 <= 1)
 
 
+def test_query_multi_matches_sequential_rounds(toy_graph, oracle,
+                                               toy_queries):
+    """The fused multi-diff campaign must reproduce the reference shape
+    of one-round-per-diff exactly: cost row d == a sequential round on
+    diff d; plen/finished shared (trajectories are diff-independent)."""
+    w_list = [None,
+              toy_graph.weights_with_diff(
+                  synth_diff(toy_graph, 0.3, seed=31)),
+              toy_graph.weights_with_diff(
+                  synth_diff(toy_graph, 0.6, seed=32))]
+    cost, plen, fin = oracle.query_multi(toy_queries, w_list)
+    assert cost.shape == (3, len(toy_queries))
+    assert fin.all()
+    for di, w in enumerate(w_list):
+        c1, p1, f1 = oracle.query(toy_queries, w_query=w)
+        np.testing.assert_array_equal(cost[di], c1)
+        np.testing.assert_array_equal(plen, p1)
+        np.testing.assert_array_equal(fin, f1)
+    import pytest
+
+    with pytest.raises(ValueError, match="at least one"):
+        oracle.query_multi(toy_queries, [])
+
+
+def test_query_multi_active_worker(toy_graph, oracle, toy_queries):
+    """-w filtering drops other workers' queries like query() does."""
+    wid = 2
+    w_list = [None, toy_graph.weights_with_diff(
+        synth_diff(toy_graph, 0.4, seed=33))]
+    cost_all, _, _ = oracle.query_multi(toy_queries, w_list)
+    cost_w, _, fin_w = oracle.query_multi(toy_queries, w_list,
+                                          active_worker=wid)
+    mine = oracle.dc.worker_of(toy_queries[:, 1]) == wid
+    np.testing.assert_array_equal(cost_w[:, mine], cost_all[:, mine])
+    assert fin_w[mine].all() and not fin_w[~mine].any()
+    assert np.all(cost_w[:, ~mine] == 0)
+
+
 def test_active_worker_filter(toy_graph, oracle, toy_queries):
     dc = oracle.dc
     wid = 3

@@ -185,6 +185,33 @@ def test_tpu_campaign_and_artifacts(dataset, tmp_path):
     assert json.load(open(os.path.join(out, "data.json")))["output"] == out
 
 
+def test_tpu_fused_diff_rounds_match_sequential(dataset, tmp_path):
+    """A multi-diff TPU campaign runs fused (one walk, all rounds); its
+    per-round stats rows must carry the same counts as sequential
+    rounds (a huge --k-moves forces the sequential path — the budget is
+    never binding, so answers are identical; only timers may differ)."""
+    datadir, paths = dataset
+    conf = ClusterConfig(
+        workers=[f"tpu:{i}" for i in range(4)],
+        partmethod="tpu", partkey=4,
+        outdir=str(tmp_path / "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=["-", paths["diff"]],
+    ).validate()
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("tpu", None, 4, g.n)
+    queries = read_scen(conf.scenfile)[:40]
+    stats_f, _ = pq.run_tpu(conf, parse_args([]), queries, dc, conf.diffs)
+    stats_s, _ = pq.run_tpu(conf, parse_args(["--k-moves", "1000000"]),
+                            queries, dc, conf.diffs)
+    assert len(stats_f) == len(stats_s) == 2       # one round per diff
+    for rows_f, rows_s in zip(stats_f, stats_s):
+        assert len(rows_f) == len(rows_s)
+        for rf, rs in zip(rows_f, rows_s):
+            assert rf[:7] == rs[:7]                # counters columns
+            assert rf[-1] == rs[-1]                # size column
+
+
 def test_tpu_campaign_matches_cpu_oracle(dataset, tmp_path):
     datadir, paths = dataset
     conf = ClusterConfig(

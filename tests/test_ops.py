@@ -162,6 +162,56 @@ def test_bucketed_walk_invariant(toy_graph, dg, toy_queries):
     assert pick_buckets(100, 6) == 5
 
 
+def test_multi_diff_fused_walk_matches_sequential(toy_graph, dg,
+                                                  toy_queries):
+    """One fused walk under D diffs must equal D sequential single-diff
+    walks exactly — costs per diff, shared plen/finished — across bucket
+    counts and with valid padding."""
+    from distributed_oracle_search_tpu.data import synth_diff
+    from distributed_oracle_search_tpu.ops.table_search import (
+        table_search_multi,
+    )
+
+    g = toy_graph
+    targets = np.arange(g.n, dtype=np.int32)
+    fm = build_fm_columns(dg, jnp.asarray(targets))
+    q = np.tile(toy_queries, (23, 1))[:144]
+    s = jnp.asarray(q[:, 0], jnp.int32)
+    t = jnp.asarray(q[:, 1], jnp.int32)
+    valid = jnp.asarray(np.arange(len(q)) % 7 != 2)
+    w_list = [None,
+              g.weights_with_diff(synth_diff(g, frac=0.3, seed=11)),
+              g.weights_with_diff(synth_diff(g, frac=0.5, seed=12))]
+    w_pads = jnp.asarray(np.stack([
+        g.padded_weights(g.w if w is None else w) for w in w_list]),
+        jnp.int32)
+    for b in (0, 1, 4):
+        cost, plen, fin = table_search_multi(dg, fm, t, s, t, w_pads,
+                                             valid=valid, n_buckets=b)
+        assert cost.shape == (3, len(q))
+        for di, w in enumerate(w_list):
+            wp = dg.w_pad if w is None else jnp.asarray(
+                g.padded_weights(w), jnp.int32)
+            c1, p1, f1 = table_search_batch(dg, fm, t, s, t, wp,
+                                            valid=valid, n_buckets=b)
+            np.testing.assert_array_equal(np.asarray(cost[di]),
+                                          np.asarray(c1))
+            np.testing.assert_array_equal(np.asarray(plen),
+                                          np.asarray(p1))
+            np.testing.assert_array_equal(np.asarray(fin),
+                                          np.asarray(f1))
+    # max_steps truncates EXACTLY like the single-diff kernel
+    # (regression: the while cond alone overshot by up to unroll-1)
+    cm, pm, fmm = table_search_multi(dg, fm, t, s, t, w_pads,
+                                     valid=valid, max_steps=3)
+    c3, p3, f3 = table_search_batch(dg, fm, t, s, t, w_pads[0],
+                                    valid=valid, max_steps=3)
+    assert int(np.asarray(pm).max()) <= 3
+    np.testing.assert_array_equal(np.asarray(cm[0]), np.asarray(c3))
+    np.testing.assert_array_equal(np.asarray(pm), np.asarray(p3))
+    np.testing.assert_array_equal(np.asarray(fmm), np.asarray(f3))
+
+
 def test_route_sorts_by_length_estimate(toy_graph):
     """route() orders each worker group by the coordinate-distance
     estimate (slot_q ascends with expected walk length) and still
