@@ -536,9 +536,25 @@ def main() -> None:
             jax.block_until_ready(warm[0])
             del warm
         log(f"table warm-up (compile): {t_tabc}")
-        with Timer() as t_prep:
-            tables = oracle.prepare_weights(w_diff)
-            jax.block_until_ready(tables[0])
+        def best_of_fresh(fn, reps=2):
+            """best_of for table prepares: the previous rep's result is
+            DROPPED before the next builds — two live table sets would
+            double peak device memory past what the budget gate
+            admitted. Best-of-2 because the shared tunneled device has
+            been observed to stall a single long execution >20x (a
+            one-shot prepare timing is worthless when that hits)."""
+            out = None
+            best = None
+            for _ in range(reps):
+                out = None               # free before rebuilding
+                with Timer() as tt:
+                    out = fn()
+                if best is None or tt.interval < best.interval:
+                    best = tt
+            return out, best
+
+        tables, t_prep = best_of_fresh(
+            lambda: jax.block_until_ready(oracle.prepare_weights(w_diff)))
         (cost_t, plen_t, fin_t), t_tab = best_of(
             lambda: oracle.query_table(tables, queries))
         assert (cost_t == cost_d).all(), \
@@ -563,6 +579,43 @@ def main() -> None:
             "table_breakeven_queries": breakeven,
         }
         del tables
+
+        # fused multi-diff tables: the doubling recursion is shared
+        # across diffs, so D diffs' tables cost ~one prepare's gather
+        # traffic (only the packed payload widens). The sequential
+        # comparison is D x this run's measured single prepare — same
+        # program, same shapes, so the product is exact, not a model.
+        n_tab_diffs = 4
+        w4t = [w_diff] + [
+            g.weights_with_diff(synth_diff(g, frac=0.1, seed=80 + i))
+            for i in range(n_tab_diffs - 1)]
+        with Timer() as t_tm_c:          # compile (fresh program)
+            warm4 = oracle.prepare_weights_multi(w4t)
+            oracle.query_table_multi(warm4, queries)
+            jax.block_until_ready(warm4[0])
+            del warm4
+        log(f"multi-table warm-up (compile): {t_tm_c}")
+        tables4, t_prep4 = best_of_fresh(
+            lambda: jax.block_until_ready(
+                oracle.prepare_weights_multi(w4t)))
+        (cm4t, pm4t, fm4t), t_tab4 = best_of(
+            lambda: oracle.query_table_multi(tables4, queries))
+        assert (cm4t[0] == cost_t).all(), \
+            "fused table plane 0 must match the single-diff tables"
+        amort = n_tab_diffs * t_prep.interval / t_prep4.interval
+        log(f"fused tables: {n_tab_diffs} diffs prepared in {t_prep4} "
+            f"(vs {n_tab_diffs} x {t_prep.interval:.1f}s sequential = "
+            f"{amort:.2f}x amortization); lookups "
+            f"{n_queries / t_tab4.interval:,.0f} q/s x {n_tab_diffs} "
+            f"diffs/gather")
+        table_stats.update({
+            "table_multi_diffs": n_tab_diffs,
+            "table_multi_prepare_seconds": round(t_prep4.interval, 3),
+            "table_multi_amortization": round(amort, 3),
+            "table_multi_queries_per_sec": round(
+                n_queries / t_tab4.interval, 1),
+        })
+        del tables4
 
     # ---- scale section: 102k-node city, single chip. One complete worker
     # shard (div/8) built with the fast-sweeping kernel and served

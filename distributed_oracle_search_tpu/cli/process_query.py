@@ -154,33 +154,30 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
         if fused is not None:
             cost, plen, fin = fused[0][di], fused[1], fused[2]
             prep_iv, search_iv = fused[3], fused[4]
-        elif use_astar:
-            import time as _time
-
-            with Timer() as prep:
-                w_query = (None if diff == "-"
-                           else graph.weights_with_diff(read_diff(diff)))
-            deadline = (_time.perf_counter() + time_ns / 1e9
-                        if time_ns else None)
-            with Timer() as search:
-                cost = np.zeros(len(queries), np.int64)
-                plen = np.zeros(len(queries), np.int64)
-                fin = np.zeros(len(queries), bool)
-                c, p, f, counters = astar_batch_np(
-                    graph, queries[active], w=w_query,
-                    hscale=args.h_scale, fscale=args.f_scale,
-                    deadline=deadline, ctx=astar_ctx,
-                    w_key=diff if not args.no_cache else None)
-                cost[active], plen[active], fin[active] = c, p, f
-            prep_iv, search_iv = prep.interval, search.interval
         else:
             with Timer() as prep:
                 w_query = (None if diff == "-"
                            else graph.weights_with_diff(read_diff(diff)))
-            with Timer() as search:
-                cost, plen, fin = oracle.query(
-                    queries, w_query=w_query, k_moves=args.k_moves,
-                    active_worker=args.worker)
+            if use_astar:
+                import time as _time
+
+                deadline = (_time.perf_counter() + time_ns / 1e9
+                            if time_ns else None)
+                with Timer() as search:
+                    cost = np.zeros(len(queries), np.int64)
+                    plen = np.zeros(len(queries), np.int64)
+                    fin = np.zeros(len(queries), bool)
+                    c, p, f, counters = astar_batch_np(
+                        graph, queries[active], w=w_query,
+                        hscale=args.h_scale, fscale=args.f_scale,
+                        deadline=deadline, ctx=astar_ctx,
+                        w_key=diff if not args.no_cache else None)
+                    cost[active], plen[active], fin[active] = c, p, f
+            else:
+                with Timer() as search:
+                    cost, plen, fin = oracle.query(
+                        queries, w_query=w_query, k_moves=args.k_moves,
+                        active_worker=args.worker)
             prep_iv, search_iv = prep.interval, search.interval
         total_moves = int(plen[active].sum())
         total_size = int(active.sum())

@@ -39,9 +39,10 @@ from ..parallel.mesh import (
 )
 from ..parallel.partition import DistributionController
 from ..parallel.sharded import (
-    build_tables_sharded, pad_targets, build_fm_sharded,
-    query_dist_sharded, query_multi_sharded, query_paths_sharded,
-    query_sharded, query_tables_sharded,
+    build_tables_multi_sharded, build_tables_sharded, pad_targets,
+    build_fm_sharded, query_dist_sharded, query_multi_sharded,
+    query_paths_sharded, query_sharded, query_tables_multi_sharded,
+    query_tables_sharded,
 )
 
 INDEX_VERSION = 1
@@ -504,6 +505,28 @@ class CPDOracle:
         scatter = (active, slot_d, wids, slot_q)
         return r_arr, s_arr, t_arr, valid, scatter
 
+    @staticmethod
+    def _unroute(scatter, nq: int, arrays, lead_flags):
+        """Scatter routed ``[D, W, Q, ...]`` device results back to input
+        query order (the inverse of :meth:`route`'s packing). Arrays
+        flagged in ``lead_flags`` carry a leading per-diff axis
+        (``[Dd, D, W, Q]``) that is preserved. Bool arrays come back
+        bool; everything else int64. Inactive queries stay zero, the
+        reference's ``-w`` filter semantics (``process_query.py:59``)."""
+        active, sd, sw, sq = scatter
+        outs = []
+        for a, lead in zip(arrays, lead_flags):
+            a = np.asarray(a)
+            dt = bool if a.dtype == np.bool_ else np.int64
+            if lead:
+                out = np.zeros((a.shape[0], nq) + a.shape[4:], dt)
+                out[:, active] = a[:, sd[active], sw[active], sq[active]]
+            else:
+                out = np.zeros((nq,) + a.shape[3:], dt)
+                out[active] = a[sd[active], sw[active], sq[active]]
+            outs.append(out)
+        return outs
+
     def query(self, queries: np.ndarray, w_query: np.ndarray | None = None,
               k_moves: int = -1, active_worker: int = -1,
               max_steps: int = 0):
@@ -523,18 +546,11 @@ class CPDOracle:
         # pay a fresh host->device upload
         w_pad = self.dg.w_pad if w_query is None else jnp.asarray(
             self.graph.padded_weights(w_query), jnp.int32)
-        cost, plen, fin = _host_tree(query_sharded(
+        outs = _host_tree(query_sharded(
             self.dg, self.fm, r_arr, s_arr, t_arr, valid, w_pad, self.mesh,
             k_moves=k_moves, max_steps=max_steps))
-        nq = len(queries)
-        active, sd, sw, sq = scatter
-        out_c = np.zeros(nq, np.int64)
-        out_p = np.zeros(nq, np.int64)
-        out_f = np.zeros(nq, bool)
-        out_c[active] = cost[sd[active], sw[active], sq[active]]
-        out_p[active] = plen[sd[active], sw[active], sq[active]]
-        out_f[active] = fin[sd[active], sw[active], sq[active]]
-        return out_c, out_p, out_f
+        return tuple(self._unroute(scatter, len(queries), outs,
+                                   (False, False, False)))
 
     def query_multi(self, queries: np.ndarray,
                     w_diffs: list[np.ndarray | None],
@@ -559,22 +575,12 @@ class CPDOracle:
             raise ValueError("w_diffs must name at least one round")
         r_arr, s_arr, t_arr, valid, scatter = self.route(
             queries, active_worker)
-        w_pads = np.stack([
-            np.asarray(self.graph.padded_weights(
-                self.graph.w if w is None else w), np.int32)
-            for w in w_diffs])
-        cost, plen, fin = _host_tree(query_multi_sharded(
+        w_pads = self.graph.padded_weights_multi(w_diffs)
+        outs = _host_tree(query_multi_sharded(
             self.dg, self.fm, r_arr, s_arr, t_arr, valid, w_pads,
             self.mesh, max_steps=max_steps))
-        nq = len(queries)
-        active, sd, sw, sq = scatter
-        out_c = np.zeros((len(w_diffs), nq), np.int64)
-        out_p = np.zeros(nq, np.int64)
-        out_f = np.zeros(nq, bool)
-        out_c[:, active] = cost[:, sd[active], sw[active], sq[active]]
-        out_p[active] = plen[sd[active], sw[active], sq[active]]
-        out_f[active] = fin[sd[active], sw[active], sq[active]]
-        return out_c, out_p, out_f
+        return tuple(self._unroute(scatter, len(queries), outs,
+                                   (True, False, False)))
 
     # ------------------------------------------------- prepared tables
     def table_memory_bytes(self) -> int:
@@ -607,12 +613,14 @@ class CPDOracle:
         amortization path for huge campaigns, including congestion-diffed
         rounds where :meth:`query_dist` does not apply.
 
-        **Measured trade (BENCH_r04 capture, 9216-node shard, v5e):**
-        prepare ~19 s, lookups ~356k q/s vs the ~265k q/s diffed walk →
-        break-even at ~19M queries per diff round (the bench recomputes
-        ``table_breakeven_queries`` from each run's own timings;
-        captures have ranged ~14-19M with the tunneled link's ±20%
-        swing). Memory: 6-8 bytes/entry = 6-8x the fm shard; calls
+        **Measured trade (BENCH_r04 captures, 9216-node shard, v5e):**
+        prepare ~19 s, lookups ~320-520k q/s vs the ~200-310k q/s
+        diffed walk → break-even ~9-34M queries per diff round (the
+        bench recomputes ``table_breakeven_queries`` from each run's
+        own timings; it divides by the small walk-vs-lookup gap, hence
+        the band — every point is the 10M-query-campaign regime).
+        :meth:`prepare_weights_multi` divides the per-diff break-even
+        by ~D. Memory: 6-8 bytes/entry = 6-8x the fm shard; calls
         whose tables exceed the per-device budget
         (``DOS_TABLE_BUDGET_GB``, default 8) raise with the math instead
         of faulting mid-campaign.
@@ -640,16 +648,24 @@ class CPDOracle:
                 f"over the {budget / 1e9:.1f} GB/device budget "
                 "(DOS_TABLE_BUDGET_GB). At this scale serve via the walk "
                 "or StreamedCPDOracle instead; the table trade only pays "
-                "past ~15M queries per diff round anyway (measured "
-                "break-even, bench table_breakeven_queries).")
+                "past ~10M queries per diff round anyway (measured "
+                "break-even band, bench table_breakeven_queries).")
         w_pad = (self.dg.w_pad if w_query is None
                  else jnp.asarray(self.graph.padded_weights(w_query),
                                   jnp.int32))
+        return self._chunked_tables(
+            lambda fm_, tw_: build_tables_sharded(
+                self.dg, fm_, tw_, w_pad, self.mesh, max_len=max_len),
+            chunk)
+
+    def _chunked_tables(self, build_one, chunk: int):
+        """Run a sharded table builder over equal padded row-chunks of
+        the target axis (one compiled program regardless of R) and trim
+        the concatenated result — the shared scaffolding of
+        :meth:`prepare_weights` and :meth:`prepare_weights_multi`."""
         r = self.targets_wr.shape[1]
         if chunk <= 0 or chunk >= r:
-            return build_tables_sharded(self.dg, self.fm, self.targets_wr,
-                                        w_pad, self.mesh, max_len=max_len)
-        # equal row-chunks (pad targets) so every chunk reuses one program
+            return build_one(self.fm, self.targets_wr)
         pad = (-r) % chunk
         tw = self.targets_wr
         fm = self.fm
@@ -659,9 +675,7 @@ class CPDOracle:
             fm = jnp.concatenate(
                 [fm, jnp.full((fm.shape[0], pad, fm.shape[2]), -1,
                               fm.dtype)], axis=1)
-        parts = [build_tables_sharded(
-                     self.dg, fm[:, i:i + chunk], tw[:, i:i + chunk],
-                     w_pad, self.mesh, max_len=max_len)
+        parts = [build_one(fm[:, i:i + chunk], tw[:, i:i + chunk])
                  for i in range(0, tw.shape[1], chunk)]
         cat = lambda xs: jnp.concatenate(xs, axis=1)[:, :r]  # noqa: E731
         c, p = zip(*parts)
@@ -676,17 +690,66 @@ class CPDOracle:
         """
         r_arr, s_arr, t_arr, valid, scatter = self.route(
             queries, active_worker)
-        c, p, f = _host_tree(query_tables_sharded(
+        outs = _host_tree(query_tables_sharded(
             tables, r_arr, s_arr, valid, self.mesh))
-        nq = len(queries)
-        active, sd, sw, sq = scatter
-        out_c = np.zeros(nq, np.int64)
-        out_p = np.zeros(nq, np.int64)
-        out_f = np.zeros(nq, bool)
-        out_c[active] = c[sd[active], sw[active], sq[active]]
-        out_p[active] = p[sd[active], sw[active], sq[active]]
-        out_f[active] = f[sd[active], sw[active], sq[active]]
-        return out_c, out_p, out_f
+        return tuple(self._unroute(scatter, len(queries), outs,
+                                   (False, False, False)))
+
+    def prepare_weights_multi(self, w_diffs: list[np.ndarray | None],
+                              max_len: int = 0, chunk: int = 1024):
+        """Fused pointer-doubling tables for D diffs at once.
+
+        The doubling recursion is shared across diffs (free-flow
+        successor function), so D diff rounds' cost tables cost ~ONE
+        prepare's gather traffic
+        (:func:`~..ops.pointer_doubling.doubled_tables_multi`) — the
+        amortization regime of a multi-diff bulk campaign. Memory:
+        ``4D + 2-4`` bytes per (row, node) entry, budget-gated like
+        :meth:`prepare_weights`. ``chunk`` defaults lower than the
+        single-diff path because each sweep's live working set widens
+        by the D cost planes.
+
+        Returns a tables handle for :meth:`query_table_multi`.
+        """
+        if self.fm is None:
+            raise RuntimeError(
+                "build() or load() before prepare_weights_multi()")
+        if not w_diffs:
+            raise ValueError("w_diffs must name at least one round")
+        from ..ops.pointer_doubling import plen_dtype
+
+        d = len(w_diffs)
+        w, r = self.targets_wr.shape
+        per_entry = 4 * d + jnp.dtype(plen_dtype(self.graph.n)).itemsize
+        need = w * r * self.graph.n * per_entry
+        n_w = max(self.mesh.shape[WORKER_AXIS], 1)
+        budget = self.TABLE_BUDGET
+        if need / n_w > budget:
+            raise ValueError(
+                f"fused tables for {d} diffs need {need / 1e9:.1f} GB "
+                f"({per_entry} B/entry over {n_w} worker shard(s) = "
+                f"{need / n_w / 1e9:.1f} GB/device) — over the "
+                f"{budget / 1e9:.1f} GB/device budget "
+                "(DOS_TABLE_BUDGET_GB). Prepare fewer diffs per call or "
+                "serve via the fused walk (query_multi) instead.")
+        w_pads = self.graph.padded_weights_multi(w_diffs)
+        return self._chunked_tables(
+            lambda fm_, tw_: build_tables_multi_sharded(
+                self.dg, fm_, tw_, w_pads, self.mesh, max_len=max_len),
+            chunk)
+
+    def query_table_multi(self, tables, queries: np.ndarray,
+                          active_worker: int = -1):
+        """Answer queries from :meth:`prepare_weights_multi` tables:
+        one ``[D]``-wide gather per query. Returns ``(cost [D, Q],
+        plen [Q], finished [Q])`` — row d identical to
+        :meth:`query_table` on diff d's tables (tests pin this)."""
+        r_arr, s_arr, t_arr, valid, scatter = self.route(
+            queries, active_worker)
+        outs = _host_tree(query_tables_multi_sharded(
+            tables, r_arr, s_arr, valid, self.mesh))
+        return tuple(self._unroute(scatter, len(queries), outs,
+                                   (True, False, False)))
 
     def query_paths(self, queries: np.ndarray, k: int,
                     active_worker: int = -1):
@@ -704,15 +767,10 @@ class CPDOracle:
             raise ValueError("k must be positive")
         r_arr, s_arr, t_arr, valid, scatter = self.route(
             queries, active_worker)
-        nodes, moves = _host_tree(query_paths_sharded(
+        outs = _host_tree(query_paths_sharded(
             self.dg, self.fm, r_arr, s_arr, t_arr, self.mesh, k=k))
-        nq = len(queries)
-        active, sd, sw, sq = scatter
-        out_n = np.zeros((nq, k + 1), np.int64)
-        out_m = np.zeros(nq, np.int64)
-        out_n[active] = nodes[sd[active], sw[active], sq[active]]
-        out_m[active] = moves[sd[active], sw[active], sq[active]]
-        return out_n, out_m
+        return tuple(self._unroute(scatter, len(queries), outs,
+                                   (False, False)))
 
     def query_dist(self, queries: np.ndarray, active_worker: int = -1):
         """Free-flow fast path: answer d(s → t) by one sharded gather.

@@ -46,7 +46,7 @@ import numpy as np
 
 from ..data.graph import Graph
 from ..ops import DeviceGraph
-from ..ops.table_search import table_search_batch
+from ..ops.table_search import table_search_batch, table_search_multi
 from ..parallel.partition import DistributionController
 from .cpd import length_estimate, shard_block_name, validate_manifest
 
@@ -193,12 +193,35 @@ class StreamedCPDOracle:
         Matches the resident oracle's :meth:`~.CPDOracle.query` semantics
         exactly (tests pin this); only the memory plan differs.
         """
-        queries = np.asarray(queries, np.int64)
-        nq = len(queries)
-        s_all, t_all = queries[:, 0], queries[:, 1]
         w_pad = (self.dg.w_pad if w_query is None
                  else jnp.asarray(self.graph.padded_weights(w_query),
                                   jnp.int32))
+        return self._campaign(queries, w_pad, None, k_moves, max_steps)
+
+    def query_multi(self, queries: np.ndarray,
+                    w_diffs: list[np.ndarray | None], max_steps: int = 0):
+        """Answer queries under D congestion diffs in ONE streamed pass.
+
+        The fused analog of :meth:`~.CPDOracle.query_multi` for the
+        streamed memory plan: each uploaded chunk is walked once and
+        every diff's costs accumulate together — and with the device
+        LRU, a fused D-round campaign after a free-flow round both
+        streams zero bytes AND walks once. Returns ``(cost [D, Q],
+        plen [Q], finished [Q])`` in input order.
+        """
+        if not w_diffs:
+            raise ValueError("w_diffs must name at least one round")
+        w_pads = jnp.asarray(self.graph.padded_weights_multi(w_diffs))
+        return self._campaign(queries, None, w_pads, -1, max_steps)
+
+    def _campaign(self, queries, w_pad, w_pads_multi, k_moves, max_steps):
+        """Shared streamed-campaign driver; ``w_pads_multi`` non-None
+        selects the fused multi-diff kernel (cost rows per diff)."""
+        queries = np.asarray(queries, np.int64)
+        nq = len(queries)
+        s_all, t_all = queries[:, 0], queries[:, 1]
+        n_multi = (0 if w_pads_multi is None
+                   else int(w_pads_multi.shape[0]))
 
         # distinct targets, ordered block-contiguously for the host gather
         uniq_t, inv = np.unique(t_all, return_inverse=True)
@@ -242,7 +265,7 @@ class StreamedCPDOracle:
             q_row = q_pos % c
             n_chunks = -(-len(uniq_t) // c) if len(uniq_t) else 0
 
-        out_c = np.zeros(nq, np.int64)
+        out_c = np.zeros((n_multi, nq) if n_multi else nq, np.int64)
         out_p = np.zeros(nq, np.int64)
         out_f = np.zeros(nq, bool)
         bytes_streamed = 0
@@ -329,16 +352,24 @@ class StreamedCPDOracle:
             round trip for however many are handed in)."""
             host = jax.device_get([o for _, o in entries])
             for (q_idx, _), (cost, plen, fin) in zip(entries, host):
-                out_c[q_idx] = cost[:len(q_idx)]
+                if n_multi:
+                    out_c[:, q_idx] = cost[:, :len(q_idx)]
+                else:
+                    out_c[q_idx] = cost[:len(q_idx)]
                 out_p[q_idx] = plen[:len(q_idx)]
                 out_f[q_idx] = fin[:len(q_idx)]
 
         pending = []          # (q_idx, device result triple) per chunk
         for ci in range(n_chunks):
             (fm_d, rows_d, s_d, t_d, v_d), q_idx = prep(ci)
-            outs = table_search_batch(
-                self.dg, fm_d, rows_d, s_d, t_d, w_pad,
-                valid=v_d, k_moves=k_moves, max_steps=max_steps)
+            if n_multi:
+                outs = table_search_multi(
+                    self.dg, fm_d, rows_d, s_d, t_d, w_pads_multi,
+                    valid=v_d, max_steps=max_steps)
+            else:
+                outs = table_search_batch(
+                    self.dg, fm_d, rows_d, s_d, t_d, w_pad,
+                    valid=v_d, k_moves=k_moves, max_steps=max_steps)
             pending.append((q_idx, outs))
             if len(pending) >= DEPTH:
                 drain(pending[:1])

@@ -22,13 +22,17 @@ Cost model — MEASURED, not aspirational, and regenerated every bench run
 the ``table_breakeven_queries`` field is computed from the same run's
 prepare/walk/lookup timings, never quoted from memory): one sweep is ONE
 packed dependent ``[R, N]`` gather (succ, cost, plen as 12 adjacent
-bytes) — ~**19 s** prepare for the full shard, then lookups at ~356k q/s
-vs the ~265k q/s diffed walk (r04 capture; the tunneled link swings
-individual runs ±20%). Break-even on those numbers: a diff round must
-answer ~**19M queries** (``prepare / (1/walk_qps − 1/lookup_qps)``;
-captures have ranged ~14-19M with the link's swing)
-before the tables pay for themselves — the regime of BASELINE.md
-configs[4]'s 10M-query DIMACS campaign, not of small scenarios. Memory:
+bytes) — ~**19 s** prepare for the full shard, then lookups at ~320-520k
+q/s vs the ~200-310k q/s diffed walk (r04 captures; the tunneled link
+swings individual runs ±20%). Break-even
+(``prepare / (1/walk_qps − 1/lookup_qps)``) divides by the small
+walk-vs-lookup gap, so captures range ~**9-34M queries** per diff round
+before the tables pay for themselves — every point in that band is the
+regime of BASELINE.md configs[4]'s 10M-query DIMACS campaign, not of
+small scenarios. ``doubled_tables_multi`` changes the arithmetic
+D-fold: the fused sweep prepares D diffs' tables for ~one prepare
+(measured 4 diffs in 16.5 s vs 18.8 s for one — the sweep is
+lane-bound, not byte-bound), dividing the per-diff break-even by ~D. Memory:
 cost int32 + sign-packed plen (int16 when ``N < 32768``) = 6-8 bytes per
 entry = **6-8x the fm shard**; ``models.cpd.prepare_weights`` enforces a
 budget gate before allocating.
@@ -123,6 +127,90 @@ def doubled_tables(dg: DeviceGraph, fm: jnp.ndarray, targets: jnp.ndarray,
     del rows
     plen_packed = jnp.where(finished, plen, -plen - 1).astype(plen_dtype(n))
     return cost, plen_packed
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def doubled_tables_multi(dg: DeviceGraph, fm: jnp.ndarray,
+                         targets: jnp.ndarray, w_pads: jnp.ndarray,
+                         max_len: int = 0):
+    """All-source cost tables for one fm shard under D diffs at once.
+
+    The successor function is diff-independent (free-flow moves), so
+    the doubling recursion is shared: one fused sweep squares ``succ``
+    and accumulates EVERY diff's costs with a single
+    ``jnp.take_along_axis`` of ``(2 + D)`` adjacent int32s per lane —
+    preparing D diff rounds' tables for ~the price of one (the sweep is
+    gather-bound; only the payload widens). ``w_pads``: int32
+    ``[D, M+1]``, one padded weight row per diff.
+
+    Returns ``(costs [R, N, D] int32, plen_packed [R, N])`` —
+    ``plen``/``finished`` ride one shared sign-packed array because the
+    trajectory is shared (:func:`doubled_tables` packing). The costs
+    layout keeps D innermost so a serving lookup reads one query's D
+    costs as one contiguous ``[D]``-wide gather
+    (:func:`lookup_tables_multi`).
+    """
+    r, n = fm.shape
+    d = w_pads.shape[0]
+    limit = n if max_len == 0 else max_len
+    x = jnp.arange(n, dtype=jnp.int32)[None, :]
+
+    slot = fm.astype(jnp.int32)
+    can = slot >= 0
+    slot_safe = jnp.maximum(slot, 0)
+    eid = dg.out_eid[x.repeat(r, 0), slot_safe]
+    nxt = dg.out_nbr[x.repeat(r, 0), slot_safe]
+    succ = jnp.where(can, nxt, x)                  # self-loop when stuck
+    costs = jnp.where(can[..., None], w_pads.T[eid], 0)      # [R, N, D]
+    plen = jnp.where(can, 1, 0).astype(jnp.int32)
+
+    n_sweeps = max(int(limit - 1).bit_length(), 1)
+
+    def cond(state):
+        i, _, _, _, changed = state
+        return changed & (i < n_sweeps)
+
+    def body(state):
+        i, succ, costs, plen, _ = state
+        packed = jnp.concatenate(
+            [succ[..., None], plen[..., None], costs], axis=-1)
+        gat = jnp.take_along_axis(packed, succ[..., None], axis=1)
+        new_succ = gat[..., 0]
+        plen = plen + gat[..., 1]
+        costs = costs + gat[..., 2:]
+        return i + 1, new_succ, costs, plen, jnp.any(new_succ != succ)
+
+    changed0 = jnp.any(succ != x)
+    _, succ, costs, plen, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), succ, costs, plen, changed0))
+
+    valid = targets >= 0
+    t_safe = jnp.where(valid, targets, 0).astype(jnp.int32)
+    finished = (succ == t_safe[:, None]) & valid[:, None]
+    plen_packed = jnp.where(finished, plen, -plen - 1).astype(plen_dtype(n))
+    return costs, plen_packed
+
+
+@jax.jit
+def lookup_tables_multi(costs: jnp.ndarray, plen_packed: jnp.ndarray,
+                        t_rows: jnp.ndarray, s: jnp.ndarray,
+                        valid: jnp.ndarray | None = None):
+    """Answer queries from fused multi-diff tables: one contiguous
+    ``[D]``-wide gather per query plus the shared plen gather.
+
+    Returns ``(cost [D, Q], plen [Q], finished [Q])``.
+    """
+    rows = t_rows.astype(jnp.int32)
+    s32 = s.astype(jnp.int32)
+    cost_qd = costs[rows, s32]                     # [Q, D] one gather
+    pp = plen_packed[rows, s32].astype(jnp.int32)
+    f = pp >= 0
+    p = jnp.where(f, pp, -pp - 1)
+    if valid is not None:                   # same masking contract as
+        cost_qd = jnp.where(valid[:, None], cost_qd, 0)  # lookup_tables
+        p = jnp.where(valid, p, 0)
+        f = f & valid
+    return cost_qd.T, p, f
 
 
 def unpack_tables(cost, plen_packed):

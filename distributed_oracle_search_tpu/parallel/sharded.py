@@ -239,6 +239,78 @@ def build_tables_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
 
 
 @functools.lru_cache(maxsize=None)
+def _tables_multi_fn(mesh: Mesh, max_len: int):
+    from ..ops.pointer_doubling import doubled_tables_multi
+
+    def _local(dg, fm_local, tgt_local, w_pads):
+        # local blocks: fm [1, R, N], tgt [1, R]; w_pads replicated
+        return doubled_tables_multi(dg, fm_local[0], tgt_local[0],
+                                    w_pads, max_len=max_len)
+
+    sm = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, None), P(WORKER_AXIS, None),
+                  P()),
+        out_specs=(P(WORKER_AXIS, None, None), P(WORKER_AXIS, None)),
+    )
+
+    def _wrap(dg, fm_wrn, tgt_wr, w_pads):
+        c, p = sm(dg, fm_wrn, tgt_wr, w_pads)
+        # shard_map emits [W*R, N, D] / [W*R, N]; restore the worker axis
+        w = fm_wrn.shape[0]
+        return (c.reshape(w, -1, dg.n, c.shape[-1]),
+                p.reshape(w, -1, dg.n))
+
+    return jax.jit(_wrap)
+
+
+def build_tables_multi_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
+                               targets_wr: np.ndarray, w_pads,
+                               mesh: Mesh, max_len: int = 0):
+    """Fused multi-diff pointer-doubling tables, one shard per worker.
+
+    ``w_pads`` int32 [D, M+1]. Returns ``(costs [W, R, N, D],
+    plen_packed [W, R, N])`` — D diffs' tables for ~one prepare's
+    gather traffic (``ops.pointer_doubling.doubled_tables_multi``).
+    """
+    tgt = jax.device_put(
+        jnp.asarray(targets_wr, jnp.int32),
+        NamedSharding(mesh, P(WORKER_AXIS, None)))
+    fn = _tables_multi_fn(mesh, max_len)
+    return fn(dg, fm_wrn, tgt, jnp.asarray(w_pads, jnp.int32))
+
+
+@functools.lru_cache(maxsize=None)
+def _query_table_multi_fn(mesh: Mesh, d: int):
+    from ..ops.pointer_doubling import lookup_tables_multi
+
+    q3 = P(DATA_AXIS, WORKER_AXIS, None)
+
+    def _local(costs, plen_packed, rows, s, valid):
+        shape = s.shape
+        c, p, f = lookup_tables_multi(costs[0], plen_packed[0],
+                                      rows.reshape(-1), s.reshape(-1),
+                                      valid.reshape(-1))
+        return (c.reshape(d, *shape), p.reshape(shape), f.reshape(shape))
+
+    sm = jax.shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(WORKER_AXIS, None, None, None),
+                  P(WORKER_AXIS, None, None), q3, q3, q3),
+        out_specs=(P(None, DATA_AXIS, WORKER_AXIS, None), q3, q3))
+    return jax.jit(sm)
+
+
+def query_tables_multi_sharded(tables, t_rows, s, valid, mesh: Mesh):
+    """Answer routed [Dg, W, Q] queries from fused multi-diff tables."""
+    costs, plen_packed = tables
+    qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
+    rows_d, s_d, v_d = jax.device_put((t_rows, s, valid), qs)
+    fn = _query_table_multi_fn(mesh, int(costs.shape[-1]))
+    return fn(costs, plen_packed, rows_d, s_d, v_d)
+
+
+@functools.lru_cache(maxsize=None)
 def _query_table_fn(mesh: Mesh):
     from ..ops.pointer_doubling import lookup_tables
 

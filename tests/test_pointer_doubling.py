@@ -98,6 +98,71 @@ def test_oracle_query_table_matches_query(toy_graph, toy_queries):
         assert f2.all()
 
 
+def test_doubled_tables_multi_matches_singles(setup):
+    """Fused multi-diff tables: cost plane d == a single-diff
+    doubled_tables run on diff d; plen/finished shared."""
+    from distributed_oracle_search_tpu.data import synth_diff
+    from distributed_oracle_search_tpu.ops.pointer_doubling import (
+        doubled_tables_multi, lookup_tables_multi,
+    )
+
+    g, fm, dg = setup
+    targets = jnp.arange(g.n, dtype=jnp.int32)
+    w_list = [None,
+              g.weights_with_diff(synth_diff(g, frac=0.3, seed=41)),
+              g.weights_with_diff(synth_diff(g, frac=0.5, seed=42))]
+    w_pads = jnp.asarray(np.stack([
+        g.padded_weights(g.w if w is None else w) for w in w_list]),
+        jnp.int32)
+    costs, pp = doubled_tables_multi(dg, jnp.asarray(fm), targets, w_pads)
+    assert costs.shape == (g.n, g.n, 3)
+    for di, w in enumerate(w_list):
+        c1, p1 = doubled_tables(
+            dg, jnp.asarray(fm), targets,
+            jnp.asarray(g.padded_weights(w), jnp.int32))
+        np.testing.assert_array_equal(np.asarray(costs[..., di]),
+                                      np.asarray(c1))
+        np.testing.assert_array_equal(np.asarray(pp), np.asarray(p1))
+    # lookup agrees with the single-diff lookup per plane, incl. padding
+    rows = jnp.asarray([3, 8, 0], jnp.int32)
+    s = jnp.asarray([1, 40, 0], jnp.int32)
+    valid = jnp.asarray([True, True, False])
+    cm, pm, fmm = lookup_tables_multi(costs, pp, rows, s, valid)
+    for di, w in enumerate(w_list):
+        c1t = doubled_tables(
+            dg, jnp.asarray(fm), targets,
+            jnp.asarray(g.padded_weights(w), jnp.int32))
+        c1, p1, f1 = lookup_tables(*c1t, rows, s, valid)
+        np.testing.assert_array_equal(np.asarray(cm[di]), np.asarray(c1))
+        np.testing.assert_array_equal(np.asarray(pm), np.asarray(p1))
+        np.testing.assert_array_equal(np.asarray(fmm), np.asarray(f1))
+
+
+def test_oracle_query_table_multi_matches_query_table(toy_graph,
+                                                      toy_queries,
+                                                      monkeypatch):
+    """End-to-end sharded: fused multi-diff tables == per-diff prepared
+    tables == the walk, with the budget gate scaling by D."""
+    import pytest
+
+    dc = DistributionController("tpu", None, 4, toy_graph.n)
+    oracle = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=4)).build()
+    w = toy_graph.weights_with_diff(synth_diff(toy_graph, frac=0.2,
+                                               seed=18))
+    w_list = [None, w]
+    tables = oracle.prepare_weights_multi(w_list, chunk=16)
+    cm, pm, fmm = oracle.query_table_multi(tables, toy_queries)
+    assert fmm.all()
+    for di, wq in enumerate(w_list):
+        c1, p1, f1 = oracle.query(toy_queries, w_query=wq)
+        assert (cm[di] == c1).all() and (pm == p1).all()
+    with pytest.raises(ValueError, match="at least one"):
+        oracle.prepare_weights_multi([])
+    monkeypatch.setenv("DOS_TABLE_BUDGET_GB", "0.000001")
+    with pytest.raises(ValueError, match="fused tables"):
+        oracle.prepare_weights_multi(w_list)
+
+
 def test_extract_paths_match_cpu_walk(setup):
     g, fm, dg = setup
     rng = np.random.default_rng(23)

@@ -112,6 +112,29 @@ def test_streamed_chunk_cache_round2_streams_zero(stream_setup,
     np.testing.assert_array_equal(c_c1, c_c2)
 
 
+def test_streamed_query_multi_matches_sequential(stream_setup):
+    """The fused multi-diff streamed campaign must equal per-diff
+    sequential streamed rounds, and a warm fused campaign streams
+    nothing (one walk AND zero upload)."""
+    g, dc, outdir, queries, resident = stream_setup
+    w_list = [None,
+              g.weights_with_diff(synth_diff(g, frac=0.2, seed=13)),
+              g.weights_with_diff(synth_diff(g, frac=0.4, seed=14))]
+    st = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
+    cm, pm, fm = st.query_multi(queries, w_list)
+    assert cm.shape == (3, len(queries))
+    for di, w in enumerate(w_list):
+        c1, p1, f1 = st.query(queries, w_query=w)
+        np.testing.assert_array_equal(cm[di], c1)
+        np.testing.assert_array_equal(pm, p1)
+        np.testing.assert_array_equal(fm, f1)
+    c2, p2, f2 = st.query_multi(queries, w_list)   # warm replay
+    assert st.last_stats["bytes_streamed"] == 0
+    np.testing.assert_array_equal(c2, cm)
+    with pytest.raises(ValueError, match="at least one"):
+        st.query_multi(queries, [])
+
+
 def test_streamed_cache_budget_and_disable(stream_setup, monkeypatch):
     """Residency never exceeds cache_bytes (LRU evicts); 0 disables."""
     g, dc, outdir, queries, resident = stream_setup
