@@ -196,6 +196,13 @@ os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
 import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+try:    # persistent compile cache: repeat runs skip the 8 mesh compiles
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 import numpy as np, tempfile, shutil
 from distributed_oracle_search_tpu.data import synth_city_graph
 from distributed_oracle_search_tpu.models.cpd import (
@@ -487,7 +494,9 @@ def main() -> None:
                 # device A*'s rate, all on the same query subset (A* is
                 # ~three orders slower per query than a table lookup;
                 # the subset keeps the bench's runtime bounded)
-                aq = min(int(os.environ.get("BENCH_ASTAR_QUERIES", 2048)),
+                # 1024 keeps the device A*'s ~27 q/s measurement out of
+                # the bench's critical path (~2.5 min at 2048)
+                aq = min(int(os.environ.get("BENCH_ASTAR_QUERIES", 1024)),
                          n_queries)
                 q_sub = np.asarray(queries[:aq])
                 t_cpu_as = _cpu_query_campaign(bins, xy, cidx, q_sub,
@@ -536,22 +545,23 @@ def main() -> None:
             jax.block_until_ready(warm[0])
             del warm
         log(f"table warm-up (compile): {t_tabc}")
-        def best_of_fresh(fn, reps=2):
-            """best_of for table prepares: the previous rep's result is
-            DROPPED before the next builds — two live table sets would
-            double peak device memory past what the budget gate
-            admitted. Best-of-2 because the shared tunneled device has
-            been observed to stall a single long execution >20x (a
-            one-shot prepare timing is worthless when that hits)."""
-            out = None
-            best = None
-            for _ in range(reps):
-                out = None               # free before rebuilding
-                with Timer() as tt:
-                    out = fn()
-                if best is None or tt.interval < best.interval:
-                    best = tt
-            return out, best
+        def best_of_fresh(fn, sane_s=40.0):
+            """Adaptive retry for table prepares: the shared tunneled
+            device has been observed to stall a single long execution
+            >20x (383 s for a true ~17 s prepare), so a reading past
+            ``sane_s`` re-runs once and keeps the best. The previous
+            rep's result is DROPPED before the retry — two live table
+            sets would double peak device memory past what the budget
+            gate admitted. A sane first reading is accepted as-is
+            (saves ~20 s on the bench's critical path)."""
+            with Timer() as t1:
+                out = fn()
+            if t1.interval <= sane_s:
+                return out, t1
+            out = None                   # free before rebuilding
+            with Timer() as t2:
+                out = fn()
+            return out, (t1 if t1.interval < t2.interval else t2)
 
         tables, t_prep = best_of_fresh(
             lambda: jax.block_until_ready(oracle.prepare_weights(w_diff)))
