@@ -22,7 +22,11 @@ uses the resident sharded oracle (sharding IS the memory plan); streaming
 is the fallback when one chip must serve an index bigger than its HBM,
 and the two share the same walk kernel and wire semantics.
 
-Uploaded row-chunks are kept on device in a bounded LRU (``cache_bytes``):
+Cold chunks upload 4-bit packed when every first-move slot fits a
+nibble (max out-degree ≤ 15, true of the grid/city family): half the
+bytes over the uplink — the cold path's bottleneck — with a one-pass
+device unpack per chunk. Uploaded row-chunks are kept on device in a
+bounded LRU (``cache_bytes``):
 campaigns whose targets overlap earlier ones — the resident-server usage
 pattern, one request round per diff (reference ``process_query.py:178``) —
 skip the upload entirely and run at near-resident speed. Range chunks key
@@ -36,6 +40,7 @@ semantics).
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import json
 import os
@@ -53,6 +58,34 @@ from .cpd import length_estimate, shard_block_name, validate_manifest
 
 def _pow2(x: int) -> int:
     return 1 << max(x - 1, 0).bit_length()
+
+
+#: first-move slots fit a nibble when the max out-degree is <= 15
+#: (slots 0..14, 0xF = the -1 "no move" marker — a degree-15 node's
+#: slots stop at 14, so the marker never collides): chunks then upload
+#: 4-bit packed — HALF the bytes over the uplink, the cold streamed
+#: path's bottleneck. DOS_STREAM_PACK4=0 disables.
+PACK4_MAX_DEGREE = 15
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _unpack4(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[C, ceil(N/2)] uint8 nibbles -> [C, N] int8 fm (0xF -> -1)."""
+    lo = packed & 0xF
+    hi = (packed >> 4) & 0xF
+    c = packed.shape[0]
+    v = jnp.stack([lo, hi], axis=-1).reshape(c, -1)[:, :n]
+    v = v.astype(jnp.int8)
+    return jnp.where(v == 15, jnp.int8(-1), v)
+
+
+def _pack4(fm_np: np.ndarray) -> np.ndarray:
+    """[C, N] int8 fm -> [C, ceil(N/2)] uint8 nibble pairs."""
+    a = fm_np.astype(np.uint8) & 0xF          # -1 -> 0xF
+    if a.shape[1] % 2:
+        a = np.concatenate(
+            [a, np.full((a.shape[0], 1), 0xF, np.uint8)], axis=1)
+    return a[:, 0::2] | (a[:, 1::2] << 4)
 
 
 def default_cache_bytes() -> int:
@@ -109,6 +142,12 @@ class StreamedCPDOracle:
         # LRU of device-resident [C, N] chunks, key (wid, r0); insertion
         # order IS the recency order (moved-to-end on hit)
         self._chunk_cache: dict[tuple[int, int], jnp.ndarray] = {}
+        #: 4-bit packed uploads when every fm slot fits a nibble —
+        #: HALF the uplink bytes on cold chunks (device unpacks once per
+        #: upload; the cache holds the unpacked chunk, so warm rounds
+        #: are unchanged)
+        self.pack4 = (graph.max_out_degree <= PACK4_MAX_DEGREE
+                      and os.environ.get("DOS_STREAM_PACK4", "1") != "0")
         #: telemetry of the most recent :meth:`query` call
         self.last_stats: dict = {}
 
@@ -269,6 +308,7 @@ class StreamedCPDOracle:
         out_p = np.zeros(nq, np.int64)
         out_f = np.zeros(nq, bool)
         bytes_streamed = 0
+        bytes_raw = 0
         cache_hits = 0
         cache_misses = 0
         # one sort up front; each chunk's queries are then a slice (the
@@ -289,7 +329,7 @@ class StreamedCPDOracle:
             their row range; compacted chunks (arbitrary row sets) are
             content-addressed by the row-id digest, so only an identical
             chunk repeats — e.g. a replayed or per-diff-round campaign."""
-            nonlocal bytes_streamed, cache_hits, cache_misses
+            nonlocal bytes_streamed, bytes_raw, cache_hits, cache_misses
             if range_mode:
                 wid_c, r0_c = int(wid_of_chunk[ci]), int(r0_of_chunk[ci])
                 key = (wid_c, r0_c, c)
@@ -312,8 +352,14 @@ class StreamedCPDOracle:
                         fm_np = np.concatenate(  # with stuck rows
                             [fm_np, np.full((c - len(take), self.graph.n),
                                             -1, np.int8)])
-                fm_dev = jnp.asarray(fm_np)
-                bytes_streamed += fm_np.nbytes
+                if self.pack4:
+                    packed = _pack4(fm_np)
+                    fm_dev = _unpack4(jnp.asarray(packed), self.graph.n)
+                    bytes_streamed += packed.nbytes
+                else:
+                    fm_dev = jnp.asarray(fm_np)
+                    bytes_streamed += fm_np.nbytes
+                bytes_raw += fm_np.nbytes
                 self._cache_put(key, fm_dev)
             lo, hi = bounds[ci], bounds[ci + 1]
             q_idx = q_by_chunk[lo:hi]
@@ -381,7 +427,12 @@ class StreamedCPDOracle:
             "n_queries": nq,
             "distinct_targets": int(len(uniq_t)),
             "row_chunks": n_chunks,
+            # wire bytes actually uploaded (packed when pack4);
+            # bytes_raw = the unpacked fm bytes those chunks represent,
+            # so artifacts stay comparable across packing modes
             "bytes_streamed": int(bytes_streamed),
+            "bytes_raw": int(bytes_raw),
+            "pack4": self.pack4,
             "cache_hits": cache_hits,
             "cache_misses": cache_misses,
             "mode": "range" if range_mode else "compacted",

@@ -28,8 +28,9 @@ def stream_setup(tmp_path_factory):
     return g, dc, outdir, queries, resident
 
 
-def test_streamed_matches_resident_free_flow(stream_setup):
+def test_streamed_matches_resident_free_flow(stream_setup, monkeypatch):
     g, dc, outdir, queries, resident = stream_setup
+    monkeypatch.delenv("DOS_STREAM_PACK4", raising=False)
     st = StreamedCPDOracle(g, dc, outdir, row_chunk=37)  # force many chunks
     c_r, p_r, f_r = resident.query(queries)
     c_s, p_s, f_s = st.query(queries)
@@ -44,8 +45,12 @@ def test_streamed_matches_resident_free_flow(stream_setup):
         # range chunks cover gaps too, so there are at least as many
         assert stats["row_chunks"] >= -(-stats["distinct_targets"] // 37)
     # both modes upload whole [C, N] chunks (range mode covers gap rows,
-    # compacted mode pads the tail chunk)
-    assert stats["bytes_streamed"] == stats["row_chunks"] * 37 * g.n
+    # compacted mode pads the tail chunk); 4-bit packing halves the
+    # wire bytes when every slot fits a nibble (this graph qualifies)
+    assert st.pack4  # city graph, K <= 15: the packed path is live
+    per_chunk = 37 * ((g.n + 1) // 2)
+    assert stats["bytes_streamed"] == stats["row_chunks"] * per_chunk
+    assert stats["bytes_raw"] == stats["row_chunks"] * 37 * g.n
 
 
 def test_streamed_matches_resident_diffed(stream_setup):
@@ -155,6 +160,37 @@ def test_streamed_cache_budget_and_disable(stream_setup, monkeypatch):
     assert st0.last_stats["cache_hits"] == 0
     assert st0.last_stats["bytes_streamed"] > 0
     np.testing.assert_array_equal(c0, c_r)
+
+
+def test_streamed_pack4_roundtrip_and_disable(stream_setup, monkeypatch):
+    """4-bit packed uploads must answer identically to unpacked ones,
+    and DOS_STREAM_PACK4=0 falls back to raw int8 chunks."""
+    import numpy as np
+
+    from distributed_oracle_search_tpu.models.streamed import (
+        _pack4, _unpack4,
+    )
+
+    g, dc, outdir, queries, resident = stream_setup
+    monkeypatch.delenv("DOS_STREAM_PACK4", raising=False)
+    # kernel-level roundtrip incl. odd N and the -1 marker
+    rng = np.random.default_rng(3)
+    fm = rng.integers(-1, 15, (5, 33)).astype(np.int8)
+    np.testing.assert_array_equal(
+        np.asarray(_unpack4(__import__("jax").numpy.asarray(_pack4(fm)),
+                            33)), fm)
+    st_p = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
+    assert st_p.pack4
+    c_p, p_p, f_p = st_p.query(queries)
+    monkeypatch.setenv("DOS_STREAM_PACK4", "0")
+    st_r = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
+    assert not st_r.pack4
+    c_r, p_r, f_r = st_r.query(queries)
+    np.testing.assert_array_equal(c_p, c_r)
+    np.testing.assert_array_equal(p_p, p_r)
+    np.testing.assert_array_equal(f_p, f_r)
+    assert st_p.last_stats["bytes_streamed"] < \
+        st_r.last_stats["bytes_streamed"]
 
 
 def test_streamed_modes_agree(stream_setup, monkeypatch):
