@@ -701,8 +701,10 @@ def main() -> None:
             cold_pack4 = bool(st.last_stats["pack4"])
             mbps = st.last_stats["bytes_streamed"] / t_q2.interval / 1e6
             log(f"scale streamed (cold): {sq} queries in {t_q2} -> "
-                f"{cold_qps:,.0f} q/s; streamed {cold_mb:,.0f}"
-                f" MB ({mbps:,.0f} MB/s incl. walk)")
+                f"{cold_qps:,.0f} q/s; streamed {cold_mb:,.0f} MB wire"
+                f" ({cold_raw_mb:,.0f} MB raw fm"
+                f"{', 4-bit packed' if cold_pack4 else ''};"
+                f" {mbps:,.0f} MB/s incl. walk)")
             # round 2+ — the serving steady state (a resident streaming
             # server answers MANY rounds over overlapping targets, one
             # per diff, reference process_query.py:178): the device LRU

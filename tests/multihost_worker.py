@@ -54,4 +54,20 @@ for wid in range(n_workers):
     got = fm_global[wid, :len(owned)]
     assert (got == golden[owned]).all(), f"worker {wid} rows differ"
 
+# fused multi-diff campaign on the cross-process mesh: both rounds of
+# one walk must match per-round sequential queries (every process
+# participates in the same SPMD program)
+from distributed_oracle_search_tpu.data import (  # noqa: E402
+    synth_diff, synth_scenario,
+)
+
+queries = synth_scenario(g.n, 24, seed=8)
+w_diff = g.weights_with_diff(synth_diff(g, frac=0.3, seed=9))
+cm, pm, fm_ = oracle.query_multi(queries, [None, w_diff])
+assert fm_.all(), "multihost fused campaign left queries unfinished"
+c0, p0, f0 = oracle.query(queries)
+c1, p1, f1 = oracle.query(queries, w_query=w_diff)
+assert (cm[0] == c0).all() and (cm[1] == c1).all(), "fused != sequential"
+assert (pm == p0).all() and (pm == p1).all()
+
 print(f"MULTIHOST_OK process={pid} devices={len(jax.devices())}")
