@@ -79,6 +79,67 @@ def effective_partition(conf: ClusterConfig, args):
 
 # ------------------------------------------------------------------ TPU path
 
+class _StreamedServe:
+    """Duck-typed stand-in for ``CPDOracle`` in :func:`run_tpu` when the
+    resident ``[R, N]`` shard would not fit device memory: the campaign
+    is served from the on-disk block files via
+    :class:`~..models.streamed.StreamedCPDOracle` (chunks LRU-cached on
+    device, 4-bit packed uploads), with the ``-w`` filter applied
+    host-side. Selected automatically when the per-device fm estimate
+    exceeds ``DOS_FM_BUDGET_GB`` (default 8), or forced with
+    ``DOS_SERVE_STREAMED=1``."""
+
+    def __init__(self, graph, dc, outdir: str, chunk: int):
+        from ..models.cpd import build_worker_shard, write_index_manifest
+        from ..models.streamed import StreamedCPDOracle
+
+        if not os.path.exists(os.path.join(outdir, "index.json")):
+            log.info("no index at %s; building per-worker block files "
+                     "in-process", outdir)
+            for wid in range(dc.maxworker):
+                build_worker_shard(graph, dc, wid, outdir, chunk=chunk)
+            write_index_manifest(outdir, dc)
+        self.dc = dc
+        self.st = StreamedCPDOracle(graph, dc, outdir)
+
+    def _split(self, queries, active_worker):
+        active = (np.ones(len(queries), bool) if active_worker == -1
+                  else self.dc.worker_of(queries[:, 1]) == active_worker)
+        return active, np.asarray(queries)[active]
+
+    def query(self, queries, w_query=None, k_moves=-1, active_worker=-1,
+              max_steps=0):
+        active, part = self._split(queries, active_worker)
+        c, p, f = self.st.query(part, w_query=w_query, k_moves=k_moves,
+                                max_steps=max_steps)
+        out = [np.zeros(len(queries), np.int64),
+               np.zeros(len(queries), np.int64),
+               np.zeros(len(queries), bool)]
+        for o, got in zip(out, (c, p, f)):
+            o[active] = got
+        return tuple(out)
+
+    def query_multi(self, queries, w_diffs, active_worker=-1,
+                    max_steps=0):
+        active, part = self._split(queries, active_worker)
+        c, p, f = self.st.query_multi(part, w_diffs, max_steps=max_steps)
+        out_c = np.zeros((len(w_diffs), len(queries)), np.int64)
+        out_p = np.zeros(len(queries), np.int64)
+        out_f = np.zeros(len(queries), bool)
+        out_c[:, active] = c
+        out_p[active] = p
+        out_f[active] = f
+        return out_c, out_p, out_f
+
+    def query_paths(self, queries, k, active_worker=-1):
+        # backstop only: run_tpu refuses --extract at plan-selection
+        # time, BEFORE any campaign work
+        raise SystemExit(
+            "--extract needs the resident oracle (path prefixes scan "
+            "device-resident fm rows); this campaign is serving "
+            "STREAMED.")
+
+
 def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
     """All diff rounds in-process on the mesh; per-worker rows recovered
     from the routed results.
@@ -112,14 +173,50 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
         astar_ctx: dict = {}
         oracle = None
     else:
-        mesh = mesh_from_config(conf)
-        oracle = CPDOracle(graph, dc, mesh=mesh)
+        # memory plan: resident sharded oracle when the per-device fm
+        # shard fits, else serve streamed from the on-disk index (the
+        # regime where one chip's N^2/W outgrows HBM — README "Serving
+        # modes"). DOS_SERVE_STREAMED=1 forces; DOS_FM_BUDGET_GB
+        # (default 8) is the per-device residency budget.
         try:
-            oracle.load(conf.outdir)
-        except FileNotFoundError:
-            log.info("no index at %s; building in-process", conf.outdir)
-            oracle.build(chunk=args.chunk)
-            oracle.save(conf.outdir)
+            fm_gb = float(os.environ.get("DOS_FM_BUDGET_GB", "8"))
+        except ValueError:
+            fm_gb = 8.0
+        est_shard = dc.max_owned * graph.n            # int8 fm bytes
+        forced = os.environ.get("DOS_SERVE_STREAMED", "") == "1"
+        if forced or est_shard > fm_gb * 1e9:
+            if getattr(args, "extract", False) and args.k_moves > 0:
+                # refuse BEFORE any work: a streamed campaign can be
+                # hours of chunk uploads; discovering the
+                # incompatibility after the stats loop would discard
+                # everything
+                why = (
+                    "DOS_SERVE_STREAMED=1 forces streaming — unset it"
+                    if forced else
+                    f"the per-device fm shard ({est_shard / 1e9:.2f} "
+                    f"GB) exceeds DOS_FM_BUDGET_GB={fm_gb:g} — raise "
+                    "the budget or shard over more workers")
+                raise SystemExit(
+                    "--extract needs the resident oracle (path "
+                    "prefixes scan device-resident fm rows), but this "
+                    f"campaign would serve STREAMED: {why}, or drop "
+                    "--extract.")
+            log.info(
+                "serving streamed%s: per-device fm shard %.2f GB vs "
+                "budget %.1f GB (DOS_FM_BUDGET_GB)",
+                " (forced by DOS_SERVE_STREAMED=1)" if forced else "",
+                est_shard / 1e9, fm_gb)
+            oracle = _StreamedServe(graph, dc, conf.outdir, args.chunk)
+        else:
+            mesh = mesh_from_config(conf)
+            oracle = CPDOracle(graph, dc, mesh=mesh)
+            try:
+                oracle.load(conf.outdir)
+            except FileNotFoundError:
+                log.info("no index at %s; building in-process",
+                         conf.outdir)
+                oracle.build(chunk=args.chunk)
+                oracle.save(conf.outdir)
 
     owner = dc.worker_of(queries[:, 1])
     time_ns = get_time_ns(args)

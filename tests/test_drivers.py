@@ -185,6 +185,46 @@ def test_tpu_campaign_and_artifacts(dataset, tmp_path):
     assert json.load(open(os.path.join(out, "data.json")))["output"] == out
 
 
+def test_tpu_streamed_serve_fallback(dataset, tmp_path, monkeypatch):
+    """When the resident shard exceeds DOS_FM_BUDGET_GB (forced here via
+    DOS_SERVE_STREAMED=1), the TPU campaign serves from the on-disk
+    index via the streamed oracle — same per-round counters as the
+    resident path, including fused multi-diff rounds and -w filtering;
+    --extract fails fast with guidance."""
+    datadir, paths = dataset
+    conf = ClusterConfig(
+        workers=[f"tpu:{i}" for i in range(4)],
+        partmethod="tpu", partkey=4,
+        outdir=str(tmp_path / "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=["-", paths["diff"]],
+    ).validate()
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("tpu", None, 4, g.n)
+    queries = read_scen(conf.scenfile)[:40]
+    stats_res, _ = pq.run_tpu(conf, parse_args([]), queries, dc,
+                              conf.diffs)
+    monkeypatch.setenv("DOS_SERVE_STREAMED", "1")
+    stats_str, _ = pq.run_tpu(conf, parse_args([]), queries, dc,
+                              conf.diffs)
+    for rows_r, rows_s in zip(stats_res, stats_str):
+        for rr, rs in zip(rows_r, rows_s):
+            assert rr[:7] == rs[:7] and rr[-1] == rs[-1]
+    # -w filter parity: one streamed run, one resident run
+    s_w, _ = pq.run_tpu(conf, parse_args(["-w", "1"]), queries, dc,
+                        conf.diffs)
+    monkeypatch.delenv("DOS_SERVE_STREAMED")
+    r_w, _ = pq.run_tpu(conf, parse_args(["-w", "1"]), queries, dc,
+                        conf.diffs)
+    for rows_r, rows_s in zip(r_w, s_w):
+        for rr, rs in zip(rows_r, rows_s):
+            assert rr[:7] == rs[:7] and rr[-1] == rs[-1]
+    monkeypatch.setenv("DOS_SERVE_STREAMED", "1")
+    with pytest.raises(SystemExit, match="resident oracle"):
+        pq.run_tpu(conf, parse_args(["--extract", "-k", "3"]), queries,
+                   dc, ["-"])
+
+
 def test_tpu_fused_diff_rounds_match_sequential(dataset, tmp_path):
     """A multi-diff TPU campaign runs fused (one walk, all rounds); its
     per-round stats rows must carry the same counts as sequential
