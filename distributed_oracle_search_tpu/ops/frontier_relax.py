@@ -7,8 +7,8 @@ every iteration; iteration count ~ the max shortest-path hop length
 N large, D large (hundreds), frontiers tiny — a 264k-node network pays
 D x N x K row-gathers while a CPU Dijkstra pays ~E log N per target
 (the reference builds exactly that way: one Dijkstra per owned node
-under OpenMP, reference ``README.md:88-95``). BENCH_r03 measured the
-dense split kernel at 0.65x ONE CPU core on that family; the dense
+under OpenMP, reference ``README.md:88-95``). Round 3's bench measured
+the dense split kernel at 0.65x ONE CPU core on that family; the dense
 sweep simply does ~D x more relaxation work than the frontier carries.
 
 This kernel keeps the relaxation *sparse* without leaving XLA's static
@@ -44,10 +44,12 @@ Measured per-iteration cost on v5e-via-tunnel is ~0.3 ms floor plus
 ~25-50 ns per gathered row, nearly independent of the row payload up
 to ~1 KB — so the batch axis B is almost free while iterations are
 expensive. The production defaults (F=2048, delta~32 x mean weight,
-S=2, B=512) hit 90-160 build rows/s on 80k-264k road graphs vs 10.5
-rows/s for one CPU core (BENCH_r03) — and the whole loop runs in ONE
-``lax.while_loop`` on device: no host round trips (the tunneled link
-pays ~90 ms per sync), no data-dependent shapes.
+S=2, B=512; every deviation swept worse) build the 264k road graph at
+23-41 rows/s across r04 captures (2.7-4.3x one CPU core's Dijkstra,
+device-window dependent) and ~80-150 rows/s on 80-132k graphs — and
+the whole loop runs in ONE ``lax.while_loop`` on device: no host round
+trips (the tunneled link pays ~90 ms per sync), no data-dependent
+shapes.
 
 The B columns share one queue (union frontier), so the kernel wants
 (a) locality-ordered node ids and (b) id-clustered target batches —
