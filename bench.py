@@ -698,7 +698,9 @@ def main() -> None:
             # captured HERE: the warm best_of rounds below overwrite
             # last_stats with zero-byte rounds
             cold_raw_mb = st.last_stats["bytes_raw"] / 1e6
-            cold_pack4 = bool(st.last_stats["pack4"])
+            # packing that RAN, not merely the enabled flag (chunks
+            # fall back individually when too many entries escape)
+            cold_pack4 = st.last_stats["chunks_packed"] > 0
             mbps = st.last_stats["bytes_streamed"] / t_q2.interval / 1e6
             log(f"scale streamed (cold): {sq} queries in {t_q2} -> "
                 f"{cold_qps:,.0f} q/s; streamed {cold_mb:,.0f} MB wire"
