@@ -748,18 +748,25 @@ def main() -> None:
             st = StreamedCPDOracle(g2, dc2, outdir, row_chunk=4096,
                                    cache_bytes=4 << 30)
             st.query(q2[:256])                 # warm-up: compile
-            # cold round: every rep drops the LRU first so each pays the
-            # full upload; wire bytes are deterministic across reps, so
-            # the stats read after the loop describe the best run too.
-            # Band: the uplink-bound candidate measured ~21 s; the r04
-            # record's 52 s was the stall this guard exists for
+            # prime the persisted RLE sidecars UNTIMED (the first-ever
+            # round pays the one-time encode, like the compile warm-up
+            # pays XLA): every timed rep below then runs the same
+            # deployment-steady-state cold path — device caches empty,
+            # compressed index on disk — so best-of reps are symmetric
+            st.clear_cache()
+            st.query(q2)
+            # cold round: every rep drops the LRU first so each pays
+            # the full (compressed) upload; wire bytes are
+            # deterministic across reps, so the stats read after the
+            # loop describe the best run too. Band: ~3 s measured for
+            # the sidecar-backed path; 15 s flags a stall
 
             def _cold():
                 st.clear_cache()
                 return st.query(q2)
             (c2, p2, f2), t_q2_s = robust_time(
                 _cold,
-                band_s=45.0 if scale_default and sq == 20_000 else None,
+                band_s=15.0 if scale_default and sq == 20_000 else None,
                 label="scale-cold-stream")
             assert bool(f2.all()), "scale campaign left unfinished queries"
             cold_qps = sq / t_q2_s
@@ -804,6 +811,11 @@ def main() -> None:
                 "scale_stream_mb": round(cold_raw_mb, 1),
                 "scale_stream_wire_mb": round(cold_mb, 1),
                 "scale_stream_pack4": cold_pack4,
+                # which wire path the cold round of record actually ran
+                # (RLE chunks / persisted-sidecar hits out of row_chunks)
+                "scale_stream_rle_chunks": st.last_stats["chunks_rle"],
+                "scale_stream_sidecar_hits":
+                    st.last_stats["sidecar_hits"],
                 "scale_stream_warm_queries_per_sec": round(warm_qps, 1),
                 "scale_stream_warm_mb": 0.0,
             }
@@ -1021,16 +1033,21 @@ def main() -> None:
                 st3 = StreamedCPDOracle(g3, dc3, out3, row_chunk=512,
                                         cache_bytes=4 << 30)
                 st3.query(q3[:256])
+                st3.clear_cache()
+                st3.query(q3)     # prime RLE sidecars untimed (encode
+                # is one-time; timed reps below all run the same
+                # compressed-index cold path — see the scale section)
 
                 def _cold3():             # cold round pays every upload
                     st3.clear_cache()
                     return st3.query(q3)
                 (c3, p3, f3), t_q3_s = robust_time(
                     _cold3,
-                    band_s=(20.0 if rn == 264_000 and rq == 20_000
+                    band_s=(8.0 if rn == 264_000 and rq == 20_000
                             else None),
                     label="road-cold-stream")
                 assert bool(f3.all())
+                road_cold_stats = dict(st3.last_stats)
                 (c3w, p3w, f3w), t_q3w = best_of(lambda: st3.query(q3))
                 assert st3.last_stats["bytes_streamed"] == 0
                 assert (c3w == c3).all()
@@ -1156,6 +1173,12 @@ def main() -> None:
                         tpu_rps3 / cpu_rps3 * cores, 2),
                     "road_stream_queries_per_sec": round(
                         rq / t_q3_s, 1),
+                    "road_stream_rle_chunks":
+                        road_cold_stats["chunks_rle"],
+                    "road_stream_sidecar_hits":
+                        road_cold_stats["sidecar_hits"],
+                    "road_stream_wire_mb": round(
+                        road_cold_stats["bytes_streamed"] / 1e6, 1),
                     "road_stream_warm_queries_per_sec": round(
                         rq / t_q3w.interval, 1),
                     "road_resident_queries_per_sec": round(rqps3, 1),

@@ -59,6 +59,21 @@ auto gate (``models.cpd.pick_build_kernel``) checks (a) explicitly via
 :func:`locality_fraction` and falls back to the dense split kernel on
 shuffled ids, where the union wavefront would span the whole graph.
 
+Negative results (round 5, measured on the 264k road graph, same
+device window as an 84-90 rows/s baseline — recorded so they are not
+re-attempted): (1) a degree-split relax (short-ELL slice for all pops
++ full-width pass for popped hubs) ran 45 rows/s — the extra
+nonzero/cumsum/scatter per iteration cost more than the 2.4x gather
+reduction saved; (2) degree-BOUNDING the graph (hub tails moved to
+zero-weight virtual-node chains, K 20 -> 6-8) kept bit-parity but
+inflated iterations 1085 -> 3000-5100 (chain hops serialize across
+pops; the unroll only re-relaxes POPPED rows) for 18-65 rows/s;
+(3) XLA scatter hints (sorted/unique) on the dist scatter: 9.2 vs 5.8
+ms/iter; (4) slot-looped relax accumulation (avoiding the [F, K, B]
+temp): within noise. Ablations show no single op dominates — the
+iteration is latency-bound through its dependency chain, so the
+remaining lever is a fused Pallas pop+relax kernel, not op shaving.
+
 Distances converge to the same unique fixed point as every other
 kernel, and first-move extraction reuses the shared full-width pass —
 tie-breaking stays bit-identical to the CPU oracle (bench asserts fm

@@ -193,9 +193,18 @@ def test_streamed_pack4_roundtrip_and_disable(stream_setup, monkeypatch):
     np.testing.assert_array_equal(got2, fm2)
     # degenerate (mostly-escape) input refuses to pack
     assert _pack4(np.full((3, 8), 20, np.int8)) is None
+    # a chunk taller than uint16 escape-row range must refuse too (the
+    # scatter indices would silently wrap and corrupt unpacked moves)
+    tall = np.zeros((65537, 2), np.int8)
+    tall[65536 - 1, 0] = 20
+    assert _pack4(tall) is None
+    # pack4-vs-raw comparison with the (better) RLE coder held off so
+    # the pack4 fallback path is what actually streams
+    monkeypatch.setenv("DOS_STREAM_RLE", "0")
     st_p = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
     assert st_p.pack4
     c_p, p_p, f_p = st_p.query(queries)
+    assert st_p.last_stats["chunks_packed"] > 0
     monkeypatch.setenv("DOS_STREAM_PACK4", "0")
     st_r = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
     assert not st_r.pack4
@@ -205,6 +214,114 @@ def test_streamed_pack4_roundtrip_and_disable(stream_setup, monkeypatch):
     np.testing.assert_array_equal(f_p, f_r)
     assert st_p.last_stats["bytes_streamed"] < \
         st_r.last_stats["bytes_streamed"]
+
+
+def test_streamed_rle_roundtrip_and_disable(stream_setup, monkeypatch):
+    """Transposed target-axis RLE uploads must answer identically to
+    dense ones, fall back when runs are short, and DOS_STREAM_RLE=0
+    disables the coder."""
+    import jax.numpy as jnp
+
+    from distributed_oracle_search_tpu.models.streamed import (
+        _pack_rle, _unpack_rle,
+    )
+
+    g, dc, outdir, queries, resident = stream_setup
+    rng = np.random.default_rng(11)
+    # blocky columns: runs of ~16 consecutive target rows per column
+    fm = np.repeat(rng.integers(-1, 6, (4, 50)).astype(np.int8),
+                   16, axis=0)[:60]
+    enc = _pack_rle(fm, pack4_viable=True)
+    assert enc is not None
+    plen, pval, counts = enc
+    assert plen.dtype == np.uint8 and counts.sum() <= len(plen)
+    got = np.asarray(_unpack_rle(jnp.asarray(plen), jnp.asarray(pval),
+                                 jnp.asarray(counts), c=fm.shape[0]))
+    np.testing.assert_array_equal(got, fm)
+    # runs longer than 255 must split into uint8 pieces and still decode
+    tall = np.tile(rng.integers(-1, 6, (1, 8)).astype(np.int8), (600, 1))
+    enc_t = _pack_rle(tall, pack4_viable=True)
+    assert enc_t is not None
+    pl_t, pv_t, ct_t = enc_t
+    got_t = np.asarray(_unpack_rle(jnp.asarray(pl_t), jnp.asarray(pv_t),
+                                   jnp.asarray(ct_t), c=600))
+    np.testing.assert_array_equal(got_t, tall)
+    # incompressible input (every row distinct from its neighbor in
+    # every column) must refuse — the dense upload is cheaper
+    noise = np.arange(64 * 32, dtype=np.int64).reshape(64, 32)
+    noise = ((noise % 13) - 1).astype(np.int8)
+    assert (noise[1:] != noise[:-1]).all()
+    assert _pack_rle(noise, pack4_viable=True) is None
+    assert _pack_rle(np.zeros((1, 5), np.int8), True) is None  # c < 2
+
+    # integration: RLE on vs off answer identically; when the coder
+    # runs it beats the dense wire byte count
+    monkeypatch.delenv("DOS_STREAM_RLE", raising=False)
+    st_on = StreamedCPDOracle(g, dc, outdir, row_chunk=64)
+    assert st_on.rle
+    c_on, p_on, f_on = st_on.query(queries)
+    stats_on = dict(st_on.last_stats)
+    monkeypatch.setenv("DOS_STREAM_RLE", "0")
+    st_off = StreamedCPDOracle(g, dc, outdir, row_chunk=64)
+    assert not st_off.rle
+    c_off, p_off, f_off = st_off.query(queries)
+    np.testing.assert_array_equal(c_on, c_off)
+    np.testing.assert_array_equal(p_on, p_off)
+    np.testing.assert_array_equal(f_on, f_off)
+    if stats_on["chunks_rle"] > 0:
+        assert stats_on["bytes_streamed"] < \
+            st_off.last_stats["bytes_streamed"]
+
+
+def test_streamed_rle_sidecar_persistence(stream_setup, monkeypatch,
+                                          tmp_path):
+    """First cold round writes rle-*.npz sidecars; a fresh oracle's cold
+    round hits them (no raw block read), answers stay identical, and a
+    rebuilt (touched) index invalidates the fingerprint."""
+    import os
+    import shutil
+
+    g, dc, outdir, queries, resident = stream_setup
+    monkeypatch.delenv("DOS_STREAM_RLE", raising=False)
+    monkeypatch.delenv("DOS_STREAM_RLE_SIDECAR", raising=False)
+    # private index copy: sidecar files written here must not leak into
+    # the shared fixture dir other tests assert against
+    priv = str(tmp_path / "idx")
+    shutil.copytree(outdir, priv,
+                    ignore=shutil.ignore_patterns("rle-*"))
+    st1 = StreamedCPDOracle(g, dc, priv, row_chunk=64)
+    c1, p1, f1 = st1.query(queries)
+    s1 = dict(st1.last_stats)
+    # every miss persists SOMETHING: the encoding, or a negative marker
+    # so incompressible chunks never re-pay the encode attempt
+    sidecars = [f for f in os.listdir(priv) if f.startswith("rle-")]
+    assert len(sidecars) == s1["cache_misses"]
+    assert s1["sidecar_hits"] == 0
+    if s1["chunks_rle"] == 0:       # coder fell back: markers only
+        st2 = StreamedCPDOracle(g, dc, priv, row_chunk=64)
+        c2, _, _ = st2.query(queries)
+        assert st2.last_stats["sidecar_hits"] == \
+            st2.last_stats["cache_misses"]      # markers were consulted
+        assert st2.last_stats["chunks_rle"] == 0
+        np.testing.assert_array_equal(c1, c2)
+        return
+    st2 = StreamedCPDOracle(g, dc, priv, row_chunk=64)
+    c2, p2, f2 = st2.query(queries)
+    s2 = dict(st2.last_stats)
+    assert s2["sidecar_hits"] == s2["chunks_rle"] == s1["chunks_rle"]
+    assert s2["bytes_streamed"] == s1["bytes_streamed"]
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_array_equal(f1, f2)
+    # stale sidecar: touching a block file changes the fingerprint
+    for f in os.listdir(priv):
+        if f.startswith("cpd-"):
+            os.utime(os.path.join(priv, f),
+                     ns=(1, 1))
+    st3 = StreamedCPDOracle(g, dc, priv, row_chunk=64)
+    c3, _, _ = st3.query(queries)
+    assert st3.last_stats["sidecar_hits"] == 0   # all invalidated
+    np.testing.assert_array_equal(c3, c1)
 
 
 def test_streamed_modes_agree(stream_setup, monkeypatch):
