@@ -295,6 +295,69 @@ print(json.dumps({{"mesh": mesh_s, "shard": shard_s,
     return json.loads(res.stdout.strip().splitlines()[-1])
 
 
+def _sharded_stream(xy: str, index: str, qfile: str):
+    """Two CPU-backed controller processes serve one streamed campaign
+    sharded: process p streams only workers ``wid % 2 == p``. Returns
+    per-process wire bytes (evidence the upload work split — the real
+    multi-chip win is W uplinks running concurrently, which one machine
+    cannot time honestly, so the bench records the byte split instead).
+    """
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    code = """
+import json, os, sys
+xy, index, qfile, coord, pid = (sys.argv[1], sys.argv[2], sys.argv[3],
+                                sys.argv[4], int(sys.argv[5]))
+from distributed_oracle_search_tpu.parallel.multihost import initialize
+initialize(coordinator=coord, num_processes=2, process_id=pid,
+           cpu_devices_per_process=4)
+import numpy as np
+from distributed_oracle_search_tpu.cli.process_query import _StreamedServe
+from distributed_oracle_search_tpu.data import Graph
+from distributed_oracle_search_tpu.parallel import DistributionController
+g = Graph.from_xy(xy)
+dc = DistributionController("mod", 4, 4, g.n)
+serve = _StreamedServe(g, dc, index, chunk=64)
+q = np.load(qfile)
+cost, plen, fin = serve.query(q)
+assert bool(np.asarray(fin).all())
+print(json.dumps({"pid": pid,
+                  "bytes": serve.st.last_stats["bytes_streamed"],
+                  "cost_sum": int(np.asarray(cost).sum())}))
+"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["DOS_STREAM_ROW_CHUNK"] = "64"
+    env["DOS_STREAM_RANGE_DENSITY"] = "0.0"
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", code, xy, index, qfile, coord, str(pid)],
+        cwd=here, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        # kill BOTH controllers: the sibling is blocked in an allgather
+        # waiting for its dead peer and would orphan otherwise
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        return None
+    if any(p.returncode != 0 for p in procs):
+        return None
+    rows = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+    if rows[0]["cost_sum"] != rows[1]["cost_sum"]:
+        return None
+    return [r["bytes"] for r in sorted(rows, key=lambda r: r["pid"])]
+
+
 def main() -> None:
     import jax
     import numpy as np
@@ -432,7 +495,7 @@ def main() -> None:
     ra, sa, ta, va, _ = oracle.route(queries)
     qsh = NamedSharding(oracle.mesh, P(DATA_AXIS, WORKER_AXIS, None))
     ra_d, sa_d, ta_d, va_d = jax.device_put((ra, sa, ta, va), qsh)
-    kern_fn = _query_fn(oracle.mesh, 0, True)
+    kern_fn = _query_fn(oracle.mesh, 0, -1)
     _, t_kern = best_of(lambda: jax.block_until_ready(kern_fn(
         oracle.dg, oracle.fm, ra_d, sa_d, ta_d, va_d,
         oracle.dg.w_pad)))
@@ -770,6 +833,9 @@ def main() -> None:
                 label="scale-cold-stream")
             assert bool(f2.all()), "scale campaign left unfinished queries"
             cold_qps = sq / t_q2_s
+            # snapshot BEFORE the warm rounds below overwrite last_stats
+            # with zero-upload rounds (the road section does the same)
+            scale_cold_stats = dict(st.last_stats)
             cold_mb = st.last_stats["bytes_streamed"] / 1e6
             # captured HERE: the warm best_of rounds below overwrite
             # last_stats with zero-byte rounds
@@ -813,9 +879,10 @@ def main() -> None:
                 "scale_stream_pack4": cold_pack4,
                 # which wire path the cold round of record actually ran
                 # (RLE chunks / persisted-sidecar hits out of row_chunks)
-                "scale_stream_rle_chunks": st.last_stats["chunks_rle"],
+                "scale_stream_rle_chunks":
+                    scale_cold_stats["chunks_rle"],
                 "scale_stream_sidecar_hits":
-                    st.last_stats["sidecar_hits"],
+                    scale_cold_stats["sidecar_hits"],
                 "scale_stream_warm_queries_per_sec": round(warm_qps, 1),
                 "scale_stream_warm_mb": 0.0,
             }
@@ -1277,6 +1344,41 @@ def main() -> None:
                 for w, s in shard_dev.items()))
         weak_stats["shard_strong_scaling_device_seconds"] = shard_dev
         weak_stats["shard_strong_scaling_rows_per_sec"] = shard_rps
+
+        # sharded streamed serving: two controller processes split one
+        # streamed campaign's uploads (each streams only its workers'
+        # rows; answers merge via allgather). CPU-mesh subprocesses,
+        # like the rest of this section.
+        from distributed_oracle_search_tpu.models.cpd import (
+            write_index_manifest,
+        )
+        sdir = tempfile.mkdtemp(prefix="dos-shstream-")
+        try:
+            gs = synth_city_graph(64, 64, seed=0)
+            dcs = DistributionController("mod", 4, 4, gs.n)
+            for wid in range(4):
+                build_worker_shard(gs, dcs, wid, sdir, chunk=256)
+            write_index_manifest(sdir, dcs)
+            xys = os.path.join(sdir, "g.xy")
+            write_xy(xys, gs.xs, gs.ys, gs.src, gs.dst, gs.w)
+            qs = synth_scenario(gs.n, 4096, seed=21)
+            qf = os.path.join(sdir, "q.npy")
+            np.save(qf, np.asarray(qs))
+            log("sharded streamed serving (2 CPU controller "
+                "processes)...")
+            split = _sharded_stream(xys, sdir, qf)
+            if split is None:
+                log("sharded streamed subprocess failed; skipping field")
+            else:
+                tot = sum(split)
+                log(f"sharded stream: per-process wire bytes {split} "
+                    f"(max share {max(split) / tot:.0%} of "
+                    f"{tot / 1e6:.1f} MB total)")
+                weak_stats["sharded_stream_bytes_per_process"] = split
+                weak_stats["sharded_stream_max_share"] = round(
+                    max(split) / tot, 3)
+        finally:
+            shutil.rmtree(sdir, ignore_errors=True)
 
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     detail = {

@@ -84,28 +84,85 @@ class _StreamedServe:
     resident ``[R, N]`` shard would not fit device memory: the campaign
     is served from the on-disk block files via
     :class:`~..models.streamed.StreamedCPDOracle` (chunks LRU-cached on
-    device, 4-bit packed uploads), with the ``-w`` filter applied
+    device, RLE/4-bit packed uploads), with the ``-w`` filter applied
     host-side. Selected automatically when the per-device fm estimate
     exceeds ``DOS_FM_BUDGET_GB`` (default 8), or forced with
-    ``DOS_SERVE_STREAMED=1``."""
+    ``DOS_SERVE_STREAMED=1``.
+
+    Multi-controller runs SHARD the streamed campaign: process p serves
+    only the workers with ``wid % process_count == p`` — its own
+    device streams only those workers' rows, and the disjoint partial
+    results merge with one allgather. This is the reference's
+    concurrent-workers shape (one resident server per worker, driven
+    concurrently — reference ``process_query.py:180-185``) applied to
+    the streaming memory plan: W processes upload 1/W of the bytes each,
+    in parallel, instead of every controller re-streaming the world.
+    A missing index is likewise built process-sharded (each process
+    writes its own workers' block files; a barrier precedes the
+    manifest)."""
 
     def __init__(self, graph, dc, outdir: str, chunk: int):
         from ..models.cpd import build_worker_shard, write_index_manifest
         from ..models.streamed import StreamedCPDOracle
+        from ..parallel.multihost import barrier, process_info
 
+        self.pidx, self.pcount = process_info()
+        #: bool [W] — workers THIS controller serves (all of them on a
+        #: single-controller run)
+        self.my_workers = (np.arange(dc.maxworker) % self.pcount
+                           == self.pidx)
         if not os.path.exists(os.path.join(outdir, "index.json")):
-            log.info("no index at %s; building per-worker block files "
-                     "in-process", outdir)
+            log.info("no index at %s; building %s block files "
+                     "in-process", outdir,
+                     "this process's workers'" if self.pcount > 1
+                     else "per-worker")
             for wid in range(dc.maxworker):
-                build_worker_shard(graph, dc, wid, outdir, chunk=chunk)
-            write_index_manifest(outdir, dc)
+                if self.my_workers[wid]:
+                    build_worker_shard(graph, dc, wid, outdir,
+                                       chunk=chunk)
+            barrier("dos-streamed-build")
+            if self.pidx == 0:
+                write_index_manifest(outdir, dc)
+            barrier("dos-streamed-manifest")
         self.dc = dc
-        self.st = StreamedCPDOracle(graph, dc, outdir)
+        try:
+            row_chunk = int(os.environ.get("DOS_STREAM_ROW_CHUNK",
+                                           "4096"))
+        except ValueError:
+            row_chunk = 4096
+        self.st = StreamedCPDOracle(graph, dc, outdir,
+                                    row_chunk=row_chunk)
 
     def _split(self, queries, active_worker):
-        active = (np.ones(len(queries), bool) if active_worker == -1
-                  else self.dc.worker_of(queries[:, 1]) == active_worker)
+        owner = self.dc.worker_of(np.asarray(queries)[:, 1])
+        active = self.my_workers[owner]
+        if active_worker != -1:
+            active = active & (owner == active_worker)
         return active, np.asarray(queries)[active]
+
+    def _merge(self, *arrays):
+        """Combine the processes' disjoint partial results (zeros/False
+        outside each process's workers) into the full campaign answer on
+        every controller. One allgather per array; no-op
+        single-controller."""
+        if self.pcount == 1:
+            return arrays
+        from ..parallel.multihost import gather_to_host
+
+        out = []
+        for a in arrays:
+            if a.dtype == np.bool_:
+                out.append(gather_to_host(a[None]).any(axis=0))
+                continue
+            # int64 payloads ride as int32 bit-pairs: jax without x64
+            # would silently downcast an int64 allgather. Disjoint
+            # support makes the bitwise trick exact — at every int32
+            # position at most one process contributes nonzero bits, so
+            # the int32 sum IS the original word pair, carry-free.
+            bits = np.ascontiguousarray(a)[None].view(np.int32)
+            g = gather_to_host(bits)             # [P, ..., 2*last]
+            out.append(g.sum(axis=0, dtype=np.int32).view(a.dtype))
+        return tuple(out)
 
     def query(self, queries, w_query=None, k_moves=-1, active_worker=-1,
               max_steps=0):
@@ -117,7 +174,7 @@ class _StreamedServe:
                np.zeros(len(queries), bool)]
         for o, got in zip(out, (c, p, f)):
             o[active] = got
-        return tuple(out)
+        return self._merge(*out)
 
     def query_multi(self, queries, w_diffs, active_worker=-1,
                     max_steps=0):
@@ -129,15 +186,48 @@ class _StreamedServe:
         out_c[:, active] = c
         out_p[active] = p
         out_f[active] = f
-        return out_c, out_p, out_f
+        return self._merge(out_c, out_p, out_f)
 
     def query_paths(self, queries, k, active_worker=-1):
-        # backstop only: run_tpu refuses --extract at plan-selection
-        # time, BEFORE any campaign work
-        raise SystemExit(
-            "--extract needs the resident oracle (path prefixes scan "
-            "device-resident fm rows); this campaign is serving "
-            "STREAMED.")
+        """Path-prefix extraction from the streamed index: the fm rows
+        each chunk uploads for the walk serve the extraction scan too
+        (``StreamedCPDOracle.query_paths``), so ``--extract`` works
+        under the streamed memory plan at no extra wire cost — and with
+        the LRU warm from the cost rounds, usually zero uploads."""
+        active, part = self._split(queries, active_worker)
+        nodes, moves = self.st.query_paths(part, k=k)
+        out_nodes = np.zeros((len(queries), k + 1), np.int64)
+        out_moves = np.zeros(len(queries), np.int64)
+        out_nodes[active] = nodes
+        out_moves[active] = moves
+        return self._merge(out_nodes, out_moves)
+
+
+def _astar_heap_campaign(graph, queries, w_query, hscale, fscale,
+                         deadline):
+    """Per-query CPU heap A* over a batch (the fast index-free serving
+    path; ``models.astar`` is the expansion-order-faithful oracle). The
+    ns deadline truncates between queries; the first always runs."""
+    import time as _time
+
+    from ..models.astar import AstarStats, astar, min_cost_per_unit
+
+    w = graph.w if w_query is None else w_query
+    cpu = min_cost_per_unit(graph, w)
+    st = AstarStats()
+    cost = np.zeros(len(queries), np.int64)
+    plen = np.zeros(len(queries), np.int64)
+    fin = np.zeros(len(queries), bool)
+    for i, (s, t) in enumerate(queries):
+        if i and deadline is not None and _time.perf_counter() > deadline:
+            break
+        cost[i], plen[i], fin[i] = astar(
+            graph, int(s), int(t), w, hscale=hscale, fscale=fscale,
+            cpu=cpu, stats=st)
+    return cost, plen, fin, dict(
+        n_expanded=st.n_expanded, n_inserted=st.n_inserted,
+        n_touched=st.n_touched, n_updated=st.n_updated,
+        n_surplus=st.n_surplus)
 
 
 def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
@@ -167,9 +257,21 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
     graph = Graph.from_xy(conf.xy_file)
     use_astar = alg == "astar"
     if use_astar:
-        # A* searches the graph directly — no CPD index involved
+        # A* searches the graph directly — no CPD index involved.
+        # Default engine: the CPU heap oracle — the batched device
+        # kernel is the index-free PARITY path, not the fast one (its
+        # dense lock-step sweeps measured ~160x slower than the heap on
+        # the bench graph, BENCH_r04), and a serving CLI must not route
+        # users to the slowest backend in the building.
+        # DOS_ASTAR_DEVICE=1 opts into the device kernel explicitly.
         from ..ops.batched_astar import astar_batch_np
 
+        astar_device = os.environ.get("DOS_ASTAR_DEVICE", "") == "1"
+        log.info(
+            "--alg astar served by the %s", "batched DEVICE kernel "
+            "(DOS_ASTAR_DEVICE=1)" if astar_device else
+            "CPU heap engine (the fast A* backend; set "
+            "DOS_ASTAR_DEVICE=1 for the batched device kernel)")
         astar_ctx: dict = {}
         oracle = None
     else:
@@ -185,22 +287,6 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
         est_shard = dc.max_owned * graph.n            # int8 fm bytes
         forced = os.environ.get("DOS_SERVE_STREAMED", "") == "1"
         if forced or est_shard > fm_gb * 1e9:
-            if getattr(args, "extract", False) and args.k_moves > 0:
-                # refuse BEFORE any work: a streamed campaign can be
-                # hours of chunk uploads; discovering the
-                # incompatibility after the stats loop would discard
-                # everything
-                why = (
-                    "DOS_SERVE_STREAMED=1 forces streaming — unset it"
-                    if forced else
-                    f"the per-device fm shard ({est_shard / 1e9:.2f} "
-                    f"GB) exceeds DOS_FM_BUDGET_GB={fm_gb:g} — raise "
-                    "the budget or shard over more workers")
-                raise SystemExit(
-                    "--extract needs the resident oracle (path "
-                    "prefixes scan device-resident fm rows), but this "
-                    f"campaign would serve STREAMED: {why}, or drop "
-                    "--extract.")
             log.info(
                 "serving streamed%s: per-device fm shard %.2f GB vs "
                 "budget %.1f GB (DOS_FM_BUDGET_GB)",
@@ -264,11 +350,16 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
                     cost = np.zeros(len(queries), np.int64)
                     plen = np.zeros(len(queries), np.int64)
                     fin = np.zeros(len(queries), bool)
-                    c, p, f, counters = astar_batch_np(
-                        graph, queries[active], w=w_query,
-                        hscale=args.h_scale, fscale=args.f_scale,
-                        deadline=deadline, ctx=astar_ctx,
-                        w_key=diff if not args.no_cache else None)
+                    if astar_device:
+                        c, p, f, counters = astar_batch_np(
+                            graph, queries[active], w=w_query,
+                            hscale=args.h_scale, fscale=args.f_scale,
+                            deadline=deadline, ctx=astar_ctx,
+                            w_key=diff if not args.no_cache else None)
+                    else:
+                        c, p, f, counters = _astar_heap_campaign(
+                            graph, queries[active], w_query,
+                            args.h_scale, args.f_scale, deadline)
                     cost[active], plen[active], fin[active] = c, p, f
             else:
                 with Timer() as search:

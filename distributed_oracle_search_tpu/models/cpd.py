@@ -185,24 +185,6 @@ def pick_build_kernel(graph: Graph, method: str = "auto"):
     return "shift", ShiftGraph(shifts, w_shift, nbr_left, w_left, graph.n)
 
 
-def pick_shift_graph(graph: Graph, method: str = "auto"):
-    """Back-compat shim: the optional ShiftGraph of the old 3-method knob
-    (sweep resolution lives in :func:`pick_build_kernel`; this never
-    resolves to sweep, so existing shift-path callers keep their kernel).
-    """
-    from ..ops.shift_relax import ShiftGraph, split_coverage
-
-    if method not in ("auto", "ell", "shift"):
-        raise ValueError(f"unknown build method {method!r}")
-    if method == "ell":
-        return None
-    shifts, w_shift, nbr_left, w_left = graph.shift_split()
-    if method == "auto" and split_coverage(w_shift,
-                                           w_left) < SHIFT_COVERAGE_MIN:
-        return None
-    return ShiftGraph(shifts, w_shift, nbr_left, w_left, graph.n)
-
-
 def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
                        outdir: str, chunk: int = 0, max_iters: int = 0,
                        resume: bool = True,

@@ -52,7 +52,9 @@ import numpy as np
 
 from ..data.graph import Graph
 from ..ops import DeviceGraph
-from ..ops.table_search import table_search_batch, table_search_multi
+from ..ops.table_search import (
+    extract_paths, table_search_batch, table_search_multi,
+)
 from ..parallel.partition import DistributionController
 from .cpd import length_estimate, shard_block_name, validate_manifest
 
@@ -445,6 +447,20 @@ class StreamedCPDOracle:
                                   jnp.int32))
         return self._campaign(queries, w_pad, None, k_moves, max_steps)
 
+    def query_paths(self, queries: np.ndarray, k: int):
+        """Materialize each query's first ``k`` path nodes from the
+        streamed index (the reference's ``--k-moves`` extraction,
+        reference ``args.py:31-36``) — per-chunk :func:`extract_paths`
+        on the uploaded fm rows, which are already device-resident for
+        the walk, so extraction costs one extra scan per chunk and no
+        extra bytes. Returns ``(nodes int64 [Q, k+1], moves int64 [Q])``
+        with the resident :meth:`~.CPDOracle.query_paths` semantics.
+        """
+        if k <= 0:
+            raise ValueError("k must be positive")
+        return self._campaign(queries, self.dg.w_pad, None, -1, 0,
+                              paths_k=k)
+
     def query_multi(self, queries: np.ndarray,
                     w_diffs: list[np.ndarray | None], max_steps: int = 0):
         """Answer queries under D congestion diffs in ONE streamed pass.
@@ -461,9 +477,12 @@ class StreamedCPDOracle:
         w_pads = jnp.asarray(self.graph.padded_weights_multi(w_diffs))
         return self._campaign(queries, None, w_pads, -1, max_steps)
 
-    def _campaign(self, queries, w_pad, w_pads_multi, k_moves, max_steps):
+    def _campaign(self, queries, w_pad, w_pads_multi, k_moves, max_steps,
+                  paths_k: int = 0):
         """Shared streamed-campaign driver; ``w_pads_multi`` non-None
-        selects the fused multi-diff kernel (cost rows per diff)."""
+        selects the fused multi-diff kernel (cost rows per diff);
+        ``paths_k`` > 0 selects path-prefix extraction instead of the
+        cost walk (returns ``(nodes, moves)``)."""
         queries = np.asarray(queries, np.int64)
         nq = len(queries)
         s_all, t_all = queries[:, 0], queries[:, 1]
@@ -512,6 +531,8 @@ class StreamedCPDOracle:
             q_row = q_pos % c
             n_chunks = -(-len(uniq_t) // c) if len(uniq_t) else 0
 
+        if paths_k:
+            out_nodes = np.zeros((nq, paths_k + 1), np.int64)
         out_c = np.zeros((n_multi, nq) if n_multi else nq, np.int64)
         out_p = np.zeros(nq, np.int64)
         out_f = np.zeros(nq, bool)
@@ -559,22 +580,21 @@ class StreamedCPDOracle:
                 # persisted-RLE fast path: a valid sidecar skips the
                 # raw block read AND the encode — the cold round's two
                 # dominant costs once the wire itself is small
+                # sidecars persist for RANGE chunks only: their names
+                # are bounded by the index's row ranges. Compacted
+                # chunks are content-addressed per campaign row set —
+                # persisting those would grow the index dir without
+                # bound as query sets vary (each unseen set a new file,
+                # never pruned); they re-encode per miss instead.
                 sc_path = fp = rk = None
-                if self.rle_sidecar:
-                    if range_mode:
-                        bs = self.dc.block_size
-                        hi = min(r0_c + c, self.dc.n_owned(wid_c))
-                        pairs = [(wid_c, b) for b in
-                                 range(r0_c // bs, (hi - 1) // bs + 1)]
-                        sc_path = os.path.join(
-                            self.outdir,
-                            f"rle-w{wid_c:05d}-r{r0_c:09d}-c{c}.npz")
-                    else:
-                        bs = self.dc.block_size
-                        pairs = sorted({(int(w), int(r) // bs) for w, r
-                                        in zip(u_wid[take], u_row[take])})
-                        sc_path = os.path.join(
-                            self.outdir, f"rle-x{key[2].hex()}-c{c}.npz")
+                if self.rle_sidecar and range_mode:
+                    bs = self.dc.block_size
+                    hi = min(r0_c + c, self.dc.n_owned(wid_c))
+                    pairs = [(wid_c, b) for b in
+                             range(r0_c // bs, (hi - 1) // bs + 1)]
+                    sc_path = os.path.join(
+                        self.outdir,
+                        f"rle-w{wid_c:05d}-r{r0_c:09d}-c{c}.npz")
                     fp = self._chunk_fingerprint(pairs)
                     rk = self._sidecar_load(sc_path, fp)
                     if rk is not None:
@@ -595,14 +615,14 @@ class StreamedCPDOracle:
                                          -1, np.int8)])
                     # wire coding, best first: transposed RLE (~7-17x),
                     # then 4-bit pack (2x), then raw — each falls back
-                    # per-chunk when its break-even check fails
-                    if self.pack4:
-                        esc_frac = (np.count_nonzero(
-                            fm_np >= PACK4_ESCAPE) / max(fm_np.size, 1))
-                        pack4_viable = esc_frac <= PACK4_MAX_ESCAPE_FRAC
-                    else:
-                        pack4_viable = False
-                    rk = (_pack_rle(fm_np, pack4_viable)
+                    # per-chunk when its break-even check fails.
+                    # RLE's break-even baseline optimistically assumes
+                    # pack4 will succeed whenever it is enabled (the
+                    # escape-heavy chunks where it would not are the
+                    # rare <0.5% hub case); computing the real escape
+                    # count here would add a full chunk pass that
+                    # _pack4 repeats anyway.
+                    rk = (_pack_rle(fm_np, self.pack4)
                           if self.rle and not skip_rle else None)
                     if sc_path is not None and not skip_rle:
                         # persist the encoding OR the negative result —
@@ -617,7 +637,7 @@ class StreamedCPDOracle:
                     bytes_streamed += (plen.nbytes + pval.nbytes
                                        + cnts.nbytes)
                     chunks_rle += 1
-                elif pack4_viable and (pk := _pack4(fm_np)) is not None:
+                elif self.pack4 and (pk := _pack4(fm_np)) is not None:
                     packed, er, ec, ev = pk
                     fm_dev = _unpack4(
                         jnp.asarray(packed), self.graph.n,
@@ -667,7 +687,13 @@ class StreamedCPDOracle:
             """Fetch + scatter a batch of finished chunks (one host
             round trip for however many are handed in)."""
             host = jax.device_get([o for _, o in entries])
-            for (q_idx, _), (cost, plen, fin) in zip(entries, host):
+            for (q_idx, _), got in zip(entries, host):
+                if paths_k:
+                    nodes, moves = got
+                    out_nodes[q_idx] = nodes[:len(q_idx)]
+                    out_p[q_idx] = moves[:len(q_idx)]
+                    continue
+                cost, plen, fin = got
                 if n_multi:
                     out_c[:, q_idx] = cost[:, :len(q_idx)]
                 else:
@@ -678,7 +704,10 @@ class StreamedCPDOracle:
         pending = []          # (q_idx, device result triple) per chunk
         for ci in range(n_chunks):
             (fm_d, rows_d, s_d, t_d, v_d), q_idx = prep(ci)
-            if n_multi:
+            if paths_k:
+                outs = extract_paths(self.dg, fm_d, rows_d, s_d, t_d,
+                                     k=paths_k)
+            elif n_multi:
                 outs = table_search_multi(
                     self.dg, fm_d, rows_d, s_d, t_d, w_pads_multi,
                     valid=v_d, max_steps=max_steps)
@@ -714,4 +743,6 @@ class StreamedCPDOracle:
             "cache_misses": cache_misses,
             "mode": "range" if range_mode else "compacted",
         }
+        if paths_k:
+            return out_nodes, out_p
         return out_c, out_p, out_f

@@ -124,12 +124,13 @@ def _walk_buckets(step, slot_at, base_of, cost0_of, limit, unroll,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("max_steps", "unroll", "n_buckets"))
+                   static_argnames=("k_moves", "max_steps", "unroll",
+                                    "n_buckets"))
 def table_search_batch(dg: DeviceGraph, fm: jnp.ndarray,
                        t_rows: jnp.ndarray, s: jnp.ndarray, t: jnp.ndarray,
                        w_query_pad: jnp.ndarray,
                        valid: jnp.ndarray | None = None,
-                       k_moves: jnp.ndarray | int = -1,
+                       k_moves: int = -1,
                        max_steps: int = 0, unroll: int = 8,
                        n_buckets: int = 0):
     """Answer a batch of queries against a first-move shard.
@@ -166,17 +167,18 @@ def table_search_batch(dg: DeviceGraph, fm: jnp.ndarray,
     n = dg.n
     r = fm.shape[0]
     limit = n if max_steps == 0 else max_steps
-    # static specialization: the common serving call passes the Python
-    # literal -1 (unlimited, the reference default) with max_steps=0 —
-    # then the per-step budget compare vanishes from the compiled
-    # program entirely (safe: a CPD walk follows a simple path, so it
-    # reaches its target or a -1 slot in < N moves; only an explicit
-    # max_steps truncation needs the exact per-step plen cap)
-    unlimited = (isinstance(k_moves, int) and k_moves < 0
-                 and max_steps == 0)
+    # static specialization: k_moves is a STATIC argname (its values are
+    # -1 or a per-campaign constant, so recompiles are bounded), which
+    # makes this a trace-time Python bool — for the common serving call
+    # (-1 unlimited, the reference default, max_steps=0) the per-step
+    # budget compare vanishes from the compiled program entirely (safe:
+    # a CPD walk follows a simple path, so it reaches its target or a
+    # -1 slot in < N moves; only an explicit truncation needs the exact
+    # per-step plen cap)
+    k_moves = int(k_moves)
+    unlimited = k_moves < 0 and max_steps == 0
     if not unlimited:
-        budget = jnp.where(jnp.asarray(k_moves) < 0, jnp.int32(limit),
-                           jnp.asarray(k_moves).astype(jnp.int32))
+        budget = jnp.int32(limit if k_moves < 0 else k_moves)
     if valid is None:
         valid = jnp.ones((q,), jnp.bool_)
     n_buckets = pick_buckets(q, n_buckets)

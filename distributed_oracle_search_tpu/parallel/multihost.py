@@ -92,31 +92,47 @@ def initialize_from_conf(conf) -> bool:
     return True
 
 
+def _runtime_active() -> bool:
+    """True when a multi-controller JAX runtime is up — via this wrapper
+    or initialized outside it (direct ``jax.distributed.initialize``, TPU
+    pod auto-init). Never triggers backend init itself."""
+    if getattr(initialize, "_done", False):
+        return True
+    try:
+        from jax._src import distributed as _jdist
+
+        return _jdist.global_state.client is not None
+    except (ImportError, AttributeError):  # private API moved: assume
+        return False                       # single-controller
+
+
+def process_info() -> tuple[int, int]:
+    """``(process_index, process_count)`` — ``(0, 1)`` on any
+    single-controller run (same guard rationale as :func:`is_primary`)."""
+    if _runtime_active():
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    return 0, 1
+
+
+def barrier(name: str) -> None:
+    """Cross-process rendezvous (no-op single-controller): every process
+    must reach it before any proceeds — e.g. all block files written
+    before one process writes the index manifest."""
+    if _runtime_active():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def is_primary() -> bool:
     """True on the process that should write shared artifacts (process 0),
     and on any single-controller run. Only consults the JAX process index
     when multi-host mode was actually initialized — a run that never
     configured ``multihost`` is always primary (a stray ``$DOS_PROCESS_ID``
     in the shell must not silently suppress campaign output)."""
-    if getattr(initialize, "_done", False):
-        import jax
-
-        return jax.process_index() == 0
-    # the JAX distributed runtime may have been initialized OUTSIDE this
-    # wrapper (direct jax.distributed.initialize, TPU pod auto-init) —
-    # every controller reporting primary would then write shared
-    # artifacts concurrently. Consult the process index iff the backend
-    # client already exists, WITHOUT triggering backend init ourselves.
-    try:
-        from jax._src import distributed as _jdist
-
-        if _jdist.global_state.client is not None:
-            import jax
-
-            return jax.process_index() == 0
-    except (ImportError, AttributeError):  # private API moved: assume
-        pass                               # single-controller
-    return True
+    return process_info()[0] == 0
 
 
 def gather_to_host(x):

@@ -405,15 +405,15 @@ def query_dist_sharded(dist_wrn: jax.Array, t_rows: np.ndarray,
 
 
 @functools.lru_cache(maxsize=None)
-def _query_fn(mesh: Mesh, max_steps: int, static_unlimited: bool = False):
+def _query_fn(mesh: Mesh, max_steps: int, k_moves: int = -1):
     q3 = P(DATA_AXIS, WORKER_AXIS, None)
 
-    def _local(dg, fm_local, rows, s, t, valid, w_pad, *k_ops):
+    def _local(dg, fm_local, rows, s, t, valid, w_pad):
         # local blocks: fm [1, R, N]; queries [D/|data|, 1, Q].
-        # static_unlimited passes the PYTHON -1 through, so the kernel's
-        # static no-budget specialization applies (a traced k_moves
-        # operand would force the per-step budget compare back in)
-        k_moves = -1 if static_unlimited else k_ops[0]
+        # k_moves is part of THIS function's cache key (a per-campaign
+        # constant), so the kernel sees a Python int and its static
+        # no-budget specialization applies — a traced k_moves operand
+        # would force the per-step budget compare back in
         fm2 = fm_local[0]
         shape = s.shape
         cost, plen, fin = table_search_batch(
@@ -423,8 +423,7 @@ def _query_fn(mesh: Mesh, max_steps: int, static_unlimited: bool = False):
 
     sm = jax.shard_map(
         _local, mesh=mesh,
-        in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, P())
-        + (() if static_unlimited else (P(),)),
+        in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, P()),
         out_specs=(q3, q3, q3),
     )
     return jax.jit(sm)
@@ -487,8 +486,5 @@ def query_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
     # and never jnp.asarray first — that is a second, default-device
     # transfer before the resharding copy
     args = jax.device_put((t_rows, s, t, valid), qs)
-    static_unlimited = (isinstance(k_moves, int) and k_moves < 0
-                        and max_steps == 0)
-    fn = _query_fn(mesh, max_steps, static_unlimited)
-    extra = () if static_unlimited else (jnp.int32(k_moves),)
-    return fn(dg, fm_wrn, *args, jnp.asarray(w_query_pad), *extra)
+    fn = _query_fn(mesh, max_steps, int(k_moves))
+    return fn(dg, fm_wrn, *args, jnp.asarray(w_query_pad))

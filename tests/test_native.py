@@ -142,6 +142,59 @@ def test_fifo_auto_campaign(bins, dataset, tmp_path, monkeypatch, compress):
             p.wait(timeout=10)
 
 
+def test_fifo_auto_time_budget_truncates_batch(bins, dataset, tmp_path,
+                                               monkeypatch):
+    """A tiny ns budget truncates inside the native engine's batch too:
+    partial ``finished`` counts through the full wire (reference
+    semantics, reference ``args.py:30-57``); the first query always
+    answers."""
+    datadir, paths = dataset
+    idx = str(tmp_path / "index")
+    for wid in range(2):
+        subprocess.run(
+            [bins["make_cpd_auto"], "--input", paths["xy"],
+             "--partmethod", "mod", "--partkey", "2",
+             "--workerid", str(wid), "--maxworker", "2", "--outdir", idx],
+            check=True, capture_output=True)
+    conf = ClusterConfig(
+        workers=["localhost"] * 2, partmethod="mod", partkey=2,
+        outdir=idx, xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=["-"], nfs=str(tmp_path),
+    ).validate()
+    fifos = {w: str(tmp_path / f"w{w}.fifo") for w in range(2)}
+    monkeypatch.setattr(pq, "command_fifo_path", lambda wid: fifos[wid])
+    procs = []
+    try:
+        for wid in range(2):
+            procs.append(subprocess.Popen(
+                [bins["fifo_auto"], "--input", paths["xy"],
+                 "--partmethod", "mod", "--partkey", "2",
+                 "--workerid", str(wid), "--maxworker", "2",
+                 "--outdir", idx, "--alg", "table-search",
+                 "--fifo", fifos[wid]], stderr=subprocess.DEVNULL))
+        deadline = time.time() + 15
+        while not all(os.path.exists(f) for f in fifos.values()):
+            assert time.time() < deadline, "fifo_auto never came up"
+            time.sleep(0.05)
+        _, stats, _ = pq.run(conf, parse_args(["--backend", "host",
+                                               "--ns-lim", "1"]))
+        n = len(read_scen(conf.scenfile))
+        for expe in stats:
+            finished = sum(r[6] for r in expe)
+            assert 2 <= finished < n, finished
+        # no budget: every query finishes
+        _, stats_full, _ = pq.run(conf, parse_args(["--backend", "host"]))
+        for expe in stats_full:
+            assert sum(r[6] for r in expe) == n
+    finally:
+        for f in fifos.values():
+            if os.path.exists(f):
+                with open(f, "w") as fh:
+                    fh.write("__DOS_STOP__\n")
+        for p in procs:
+            p.wait(timeout=10)
+
+
 def test_native_and_python_servers_interoperable(bins, dataset, tmp_path,
                                                  monkeypatch):
     """One native worker + one Python worker serving the same campaign:

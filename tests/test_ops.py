@@ -101,6 +101,15 @@ def test_k_moves_budget(toy_graph, dg, toy_queries):
     t = jnp.asarray(toy_queries[:, 1], jnp.int32)
     _, plen_all, fin_all = table_search_batch(dg, fm, t, s, t, dg.w_pad)
     _, plen2, fin2 = table_search_batch(dg, fm, t, s, t, dg.w_pad, k_moves=2)
+    # k_moves is a STATIC argname: the unlimited default (-1) compiles a
+    # program with NO per-step budget compare — pin that the budgeted
+    # lowering is strictly larger, so the specialization cannot silently
+    # regress to a traced operand again (advisor r4 found exactly that)
+    hlo_unl = table_search_batch.lower(
+        dg, fm, t, s, t, dg.w_pad, k_moves=-1).as_text()
+    hlo_bud = table_search_batch.lower(
+        dg, fm, t, s, t, dg.w_pad, k_moves=2).as_text()
+    assert len(hlo_bud) > len(hlo_unl)
     plen_all, fin_all, plen2, fin2 = map(
         np.asarray, (plen_all, fin_all, plen2, fin2))
     assert np.all(plen2 <= 2)

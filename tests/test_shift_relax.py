@@ -10,7 +10,7 @@ import pytest
 
 from distributed_oracle_search_tpu.data import Graph, synth_city_graph
 from distributed_oracle_search_tpu.data.graph import INF
-from distributed_oracle_search_tpu.models.cpd import pick_shift_graph
+from distributed_oracle_search_tpu.models.cpd import pick_build_kernel
 from distributed_oracle_search_tpu.models.reference import dist_to_target
 from distributed_oracle_search_tpu.ops import DeviceGraph
 from distributed_oracle_search_tpu.ops.bellman_ford import dist_to_targets
@@ -90,11 +90,12 @@ def test_shift_handles_padding_targets(toy_graph):
 
 
 def test_auto_method_selection(toy_graph):
-    assert pick_shift_graph(toy_graph, "auto") is not None  # grid ids
-    assert pick_shift_graph(toy_graph, "ell") is None
-    assert pick_shift_graph(toy_graph, "shift") is not None
+    kind, st = pick_build_kernel(toy_graph, "ell")
+    assert kind == "ell" and st is None
+    kind, st = pick_build_kernel(toy_graph, "shift")
+    assert kind == "shift" and st is not None
     with pytest.raises(ValueError, match="unknown build method"):
-        pick_shift_graph(toy_graph, "bogus")
+        pick_build_kernel(toy_graph, "bogus")
 
 
 def test_oracle_build_methods_agree(toy_graph, toy_queries):

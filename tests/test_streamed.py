@@ -74,6 +74,19 @@ def test_streamed_k_moves_budget(stream_setup):
     assert (np.asarray(p_s) <= 3).all()
 
 
+def test_streamed_query_paths_matches_resident(stream_setup):
+    """Path-prefix extraction from the streamed index must equal the
+    resident oracle's rows exactly (same fm, same scan kernel)."""
+    g, dc, outdir, queries, resident = stream_setup
+    st = StreamedCPDOracle(g, dc, outdir, row_chunk=37)
+    n_r, m_r = resident.query_paths(queries, k=5)
+    n_s, m_s = st.query_paths(queries, k=5)
+    np.testing.assert_array_equal(n_s, n_r)
+    np.testing.assert_array_equal(m_s, m_r)
+    with pytest.raises(ValueError, match="positive"):
+        st.query_paths(queries, k=0)
+
+
 def test_streamed_rejects_mismatched_controller(stream_setup):
     g, dc, outdir, _, _ = stream_setup
     other = DistributionController("mod", 2, 2, g.n)
