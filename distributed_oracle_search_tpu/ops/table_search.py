@@ -35,7 +35,15 @@ from .device_graph import DeviceGraph
 #: dispatch overhead (swept end-to-end on the 50k bench across rounds:
 #: 64/1024 > 32/2048 > 16/4096 with the lean step — narrower buckets hug
 #: the est-sorted length profile, and the per-iteration floor, not lane
-#: width, is the binding cost at this size)
+#: width, is the binding cost at this size).
+#:
+#: Round-5 re-sweep (real chip, same 50k bench): 64/unroll=8 113 ms,
+#: 32/8 117 ms, 16/8 127 ms, 64/16 117 ms, 64/4 112 ms — the current
+#: default stays speed-optimal. NOTE the bench's raw gather-utilization
+#: figure moves the OTHER way (16 buckets issue 4.3M lanes at 67 M/s vs
+#: 64's 3.5M at 62 M/s): wider buckets pad more wasted lanes which
+#: inflate the issued RATE while slowing the actual answer. The knob is
+#: tuned for wall-clock, never for that ratio.
 BUCKET_LANES = 1024
 BUCKET_MAX = 64
 

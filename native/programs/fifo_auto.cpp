@@ -250,8 +250,13 @@ struct Server {
                     // `finished` count comes back partial. Query 0
                     // always runs — an expired budget still yields a
                     // minimal answer (same rule as the A* chunk path).
-                    if (q > 0 && deadline > 0 && now_s() > deadline)
+                    // Table-search still counts the query as touched
+                    // (= received), matching the Python engine's
+                    // n_touched = batch size under truncation.
+                    if (q > 0 && deadline > 0 && now_s() > deadline) {
+                        if (!use_astar && !use_ch) local.n_touched += 1;
                         continue;
+                    }
                     auto [s, t] = queries[q];
                     if (use_astar) {
                         astar(g, s, t, wq, hscale, fscale, local, cpu);
