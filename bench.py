@@ -1073,13 +1073,17 @@ def main() -> None:
                 )
                 build3 = lambda t: build_fm_columns(  # noqa: E731
                     dg3, jnp.asarray(t))
+            from distributed_oracle_search_tpu.models.cpd import fetch_fm
             tgt64 = np.arange(trows, dtype=np.int32)
-            jax.block_until_ready(build3(tgt64))             # compile
-            # band: r04 measured 12.6-14.3 s for these 512 rows at the
-            # default 264k nodes
+            fetch_fm(build3(tgt64))           # compile build + encode
+            # end-to-end incl. the host materialization (the build's
+            # real product is block files): the RLE fetch ships ~3
+            # bytes/run instead of the raw 135 MB, which a 12-60 MB/s
+            # link window turned into up to half the build time.
+            # Band: ~8 s for these 512 rows at the default 264k nodes
             fm64, t_b3_s = robust_time(
-                lambda: np.asarray(build3(tgt64)),           # [512, N]
-                band_s=25.0 if rn == 264_000 else None,
+                lambda: fetch_fm(build3(tgt64)),             # [512, N]
+                band_s=14.0 if rn == 264_000 else None,
                 label="road-build")
             tpu_rps3 = trows / t_b3_s
             log(f"road TPU build ({kind3}): {trows} rows in "

@@ -21,6 +21,28 @@ def oracle(request, toy_graph):
     return CPDOracle(toy_graph, dc).build(chunk=3)
 
 
+def test_fetch_fm_rle_roundtrip(monkeypatch):
+    """The RLE-compressed device->host fm fetch must be bit-identical to
+    a plain fetch — blocky, incompressible, and tiny inputs, with the
+    size gate forced off so the compressed path actually runs."""
+    import jax.numpy as jnp
+
+    from distributed_oracle_search_tpu.models import cpd as cpd_mod
+    from distributed_oracle_search_tpu.models.cpd import fetch_fm
+
+    monkeypatch.setattr(cpd_mod, "FETCH_RLE_MIN_BYTES", 0)
+    rng = np.random.default_rng(7)
+    blocky = np.repeat(rng.integers(-1, 6, (5, 40)).astype(np.int8),
+                       13, axis=0)[:60]
+    np.testing.assert_array_equal(fetch_fm(jnp.asarray(blocky)), blocky)
+    noise = ((np.arange(64 * 32).reshape(64, 32) % 13) - 1).astype(np.int8)
+    np.testing.assert_array_equal(fetch_fm(jnp.asarray(noise)), noise)
+    tiny = np.zeros((1, 5), np.int8)       # c < 2: plain path
+    np.testing.assert_array_equal(fetch_fm(jnp.asarray(tiny)), tiny)
+    monkeypatch.setenv("DOS_FETCH_RLE", "0")
+    np.testing.assert_array_equal(fetch_fm(jnp.asarray(blocky)), blocky)
+
+
 def test_sharded_build_matches_cpu_oracle(toy_graph, oracle):
     fm = np.asarray(oracle.fm)
     dc = oracle.dc

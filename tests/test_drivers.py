@@ -655,6 +655,75 @@ def test_tpu_campaign_astar(dataset, tmp_path, monkeypatch):
         pq.run(conf, parse_args(["--alg", "ch", "--backend", "tpu"]))
 
 
+def test_dimacs_gr_co_pipeline_end_to_end(tmp_path):
+    """The DIMACS road pipeline as the reference's scale-up flow runs
+    it (BASELINE.md configs[5]), end to end on a real ``.gr``/``.co``
+    artifact: convert -> RCM reorder (graph + scen together) -> build a
+    sharded index -> answer a campaign -> costs equal the CPU oracle on
+    the ORIGINAL ids. The reference's actual NY files are stripped from
+    its snapshot, so the artifact is a synthetic road network written in
+    the real interchange format — every downstream step consumes only
+    the files."""
+    from distributed_oracle_search_tpu.cli.reorder import main as rmain
+    from distributed_oracle_search_tpu.data import synth_road_network
+    from distributed_oracle_search_tpu.data.dimacs import main as dmain
+    from distributed_oracle_search_tpu.data.formats import write_scen
+    from distributed_oracle_search_tpu.models.reference import (
+        dist_to_target,
+    )
+
+    g = synth_road_network(900, seed=11)
+    gr, co = str(tmp_path / "r.gr"), str(tmp_path / "r.co")
+    with open(gr, "w") as f:
+        f.write(f"c synthetic road, DIMACS format\np sp {g.n} {g.m}\n")
+        for u, v, w in zip(g.src, g.dst, g.w):
+            f.write(f"a {u + 1} {v + 1} {w}\n")
+    with open(co, "w") as f:
+        f.write(f"p aux sp co {g.n}\n")
+        for i, (x, y) in enumerate(zip(g.xs, g.ys)):
+            f.write(f"v {i + 1} {x} {y}\n")
+    rng = np.random.default_rng(12)
+    q_orig = np.stack([rng.integers(0, g.n, 64),
+                       rng.integers(0, g.n, 64)], axis=1)
+    scen0 = str(tmp_path / "r.scen")
+    write_scen(scen0, q_orig)
+
+    xy0 = str(tmp_path / "road.xy")
+    assert dmain(["--gr", gr, "--co", co, "-o", xy0]) == 0
+    xy1 = str(tmp_path / "road-rcm.xy")
+    scen1 = str(tmp_path / "r-rcm.scen")
+    assert rmain(["--input", xy0, "--order", "rcm", "-o", xy1,
+                  "--scen", scen0, scen1]) == 0
+
+    conf = ClusterConfig(
+        workers=[f"tpu:{i}" for i in range(4)],
+        partmethod="tpu", partkey=4,
+        outdir=str(tmp_path / "index"),
+        xy_file=xy1, scenfile=scen1, diffs=["-"],
+    ).validate()
+    data, stats, _ = pq.run(conf, parse_args([]))
+    for expe in stats:
+        assert sum(r[6] for r in expe) == len(q_orig)
+
+    # cost parity back on ORIGINAL ids: the .order sidecar maps new->old
+    order = np.loadtxt(xy1 + ".order", dtype=np.int64)
+    g1 = Graph.from_xy(xy1)
+    from distributed_oracle_search_tpu.models.cpd import CPDOracle
+    from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+    dc = DistributionController("tpu", 4, 4, g1.n)
+    o = CPDOracle(g1, dc, mesh=make_mesh(n_workers=4))
+    o.load(conf.outdir)
+    q1 = read_scen(scen1)
+    cost, _, fin = o.query(q1)
+    assert bool(np.asarray(fin).all())
+    for i in (0, 7, 33, 63):
+        s_new, t_new = int(q1[i, 0]), int(q1[i, 1])
+        assert int(order[s_new]) == q_orig[i, 0]
+        assert int(order[t_new]) == q_orig[i, 1]
+        golden = dist_to_target(g, int(q_orig[i, 1]))[q_orig[i, 0]]
+        assert int(cost[i]) == int(golden), i
+
+
 def test_order_flag_points_to_reorder_tool(dataset, tmp_path):
     """--order on a campaign fails fast with the dataset-prep guidance
     (reordering per campaign would desync from the on-disk index)."""
