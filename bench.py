@@ -222,6 +222,19 @@ def _cpu_query_campaign(bins, xy, index, scen_queries, workdir,
     return best
 
 
+def _timed_cpu_build(bins, args: list, label: str) -> float:
+    """Best-of-2 native CPD build (the reference baseline): the single
+    shared core is subject to host contention like the device is to
+    stalls, and a starved CPU baseline inflates every tpu_* speedup.
+    ``--no-resume`` so rep 2 recomputes instead of skipping blocks."""
+    _, best = robust_time(
+        lambda: subprocess.run(
+            [bins["make_cpd_auto"], *args, "--no-resume"],
+            check=True, capture_output=True),
+        label=label)
+    return best
+
+
 def _weak_scaling(side: int, chunk: int):
     """Build-time vs worker count on a virtual CPU mesh (subprocess so the
     TPU-pinned parent process cannot leak in). Same TOTAL rows each run.
@@ -559,20 +572,19 @@ def main() -> None:
                 xy = os.path.join(cdir, "city.xy")
                 cidx = os.path.join(cdir, "index")
                 write_xy(xy, g.xs, g.ys, g.src, g.dst, g.w)
-                with Timer() as t_cpu_b:
-                    subprocess.run(
-                        [bins["make_cpd_auto"], "--input", xy,
-                         "--partmethod", "mod", "--partkey", "1",
-                         "--workerid", "0", "--maxworker", "1",
-                         "--outdir", cidx],
-                        check=True, capture_output=True)
+                t_cpu_b_s = _timed_cpu_build(
+                    bins, ["--input", xy, "--partmethod", "mod",
+                           "--partkey", "1", "--workerid", "0",
+                           "--maxworker", "1", "--outdir", cidx],
+                    label="cpu-build")
                 t_cpu_q = _cpu_query_campaign(bins, xy, cidx, queries,
                                               cdir)
                 cores = os.cpu_count() or 1
                 cpu_qps = n_queries / t_cpu_q
-                build_speedup = t_cpu_b.interval / t_build_s
+                build_speedup = t_cpu_b_s / t_build_s
                 query_speedup = t_cpu_q / t_scen.interval
-                log(f"CPU baseline ({cores} core(s)): build {t_cpu_b} "
+                log(f"CPU baseline ({cores} core(s)): build "
+                    f"{t_cpu_b_s:.2f}s "
                     f"(tpu {build_speedup:.1f}x), campaign t_search "
                     f"{t_cpu_q:.3f}s -> {cpu_qps:,.0f} q/s "
                     f"(tpu walk {query_speedup:.2f}x, dist "
@@ -589,7 +601,7 @@ def main() -> None:
                     "cpu_denominator": (
                         f"measured on {cores} core(s); parity_cores = "
                         "OpenMP cores (linear scaling) needed to match"),
-                    "cpu_build_seconds": round(t_cpu_b.interval, 2),
+                    "cpu_build_seconds": round(t_cpu_b_s, 2),
                     "cpu_queries_per_sec": round(cpu_qps, 1),
                     "tpu_build_speedup": round(build_speedup, 2),
                     "tpu_build_parity_cores": round(
@@ -956,18 +968,14 @@ def main() -> None:
                     xy2 = os.path.join(outdir, "scale.xy")
                     write_xy(xy2, g2.xs, g2.ys, g2.src, g2.dst, g2.w)
                     sub_rows = 512
-                    with Timer() as t_cb2:
-                        subprocess.run(
-                            [bins["make_cpd_auto"], "--input", xy2,
-                             "--partmethod", "div",
-                             "--partkey", str(sub_rows),
-                             "--workerid", "0",
-                             "--maxworker",
-                             str(-(-g2.n // sub_rows)),
-                             "--outdir",
-                             os.path.join(outdir, "cpuidx")],
-                            check=True, capture_output=True)
-                    cpu_rps2 = sub_rows / t_cb2.interval
+                    t_cb2_s = _timed_cpu_build(
+                        bins, ["--input", xy2, "--partmethod", "div",
+                               "--partkey", str(sub_rows),
+                               "--workerid", "0", "--maxworker",
+                               str(-(-g2.n // sub_rows)), "--outdir",
+                               os.path.join(outdir, "cpuidx")],
+                        label="scale-cpu-build")
+                    cpu_rps2 = sub_rows / t_cb2_s
                     t_cpu_q2 = _cpu_query_campaign(
                         bins, xy2, outdir, q2, outdir,
                         partmethod="div", partkey=per_w, workerid=0,
@@ -1094,21 +1102,19 @@ def main() -> None:
             if bins is not None:
                 xy3 = os.path.join(out3, "road.xy")
                 write_xy(xy3, g3.xs, g3.ys, g3.src, g3.dst, g3.w)
-                with Timer() as t_cb3:
-                    subprocess.run(
-                        [bins["make_cpd_auto"], "--input", xy3,
-                         "--partmethod", "div", "--partkey", str(sub),
-                         "--workerid", "0", "--maxworker", str(mw3),
-                         "--outdir", out3],
-                        check=True, capture_output=True)
-                cpu_rps3 = sub / t_cb3.interval
+                t_cb3_s = _timed_cpu_build(
+                    bins, ["--input", xy3, "--partmethod", "div",
+                           "--partkey", str(sub), "--workerid", "0",
+                           "--maxworker", str(mw3), "--outdir", out3],
+                    label="road-cpu-build")
+                cpu_rps3 = sub / t_cb3_s
                 # correctness gate: ELL build and native Dijkstra must
                 # produce bit-identical first moves on this graph too
                 blk0 = np.load(os.path.join(
                     out3, "cpd-w00000-b00000.npy"))
                 assert (blk0[:trows] == fm64).all(), \
                     "road: TPU ELL fm rows != native Dijkstra rows"
-                log(f"road CPU build: {sub} rows in {t_cb3} -> "
+                log(f"road CPU build: {sub} rows in {t_cb3_s:.2f}s -> "
                     f"{cpu_rps3:,.1f} rows/s (tpu "
                     f"{tpu_rps3 / cpu_rps3:.2f}x); fm parity ok")
 
@@ -1348,8 +1354,8 @@ def main() -> None:
                 _, t_sh_s = robust_time(
                     lambda: build_worker_shard(g, dcw, 0, d, chunk=chunk),
                     reset=_reset_sh,
-                    # ~2x the r04 record readings per W, default knobs only
-                    band_s=({1: 6.0, 2: 2.5, 4: 1.6, 8: 1.2}[wsh]
+                    # ~2x the best r05 readings per W, default knobs only
+                    band_s=({1: 4.0, 2: 2.2, 4: 1.4, 8: 0.9}[wsh]
                             if (width, height) == (96, 96) and chunk == 512
                             else None),
                     label=f"shard-w{wsh}")
