@@ -1269,6 +1269,7 @@ def main() -> None:
                     "road_shift_coverage_raw": round(cov_raw, 4),
                     "road_shift_coverage_rcm": round(cov_rcm, 4),
                     "road_build_kernel": kind3,
+                    "road_build_rows": trows,
                     "road_tpu_build_rows_per_sec": round(tpu_rps3, 2),
                     "road_cpu_build_rows_per_sec": round(cpu_rps3, 2),
                     "road_build_parity_cores": round(
