@@ -1453,6 +1453,11 @@ def main() -> None:
         "devices": len(devices),
         "platform": devices[0].platform,
     }
+    # structured internals: the obs registry's counters + per-phase
+    # histograms accumulated by whatever instrumented paths this run
+    # exercised (detail file only — the stdout line stays compact)
+    from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+    detail["obs"] = obs_metrics.REGISTRY.snapshot()
     payload = {
         "metric": "scenario_queries_per_sec",
         "value": round(qps, 1),

@@ -147,6 +147,18 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                      default="python",
                      help="Host-mode worker engine: the JAX shard engine "
                           "or the native C++ binaries (./install.sh).")
+
+    obs = p.add_argument_group("observability")
+    obs.add_argument("--trace", type=str, default="",
+                     help="Write a merged Chrome trace-event JSON of the "
+                          "campaign's head + worker spans to this path "
+                          "(open in Perfetto or chrome://tracing); the "
+                          "per-batch trace_id rides the FIFO wire as a "
+                          "RuntimeConfig extension.")
+    obs.add_argument("--metrics-dump", type=str, default="",
+                     help="Write a JSON snapshot of the obs.metrics "
+                          "registry (counters / gauges / histograms) to "
+                          "this path at campaign end.")
     return p
 
 

@@ -27,6 +27,7 @@ reference's ``[[i] + row for i, row in stats]`` mis-unpacks, SURVEY.md §2.1).
 from __future__ import annotations
 
 import csv
+import dataclasses
 import json
 import os
 import sys
@@ -35,6 +36,8 @@ import numpy as np
 
 from .args import get_time_ns, parse_args
 from ..data.formats import read_diff, read_scen, xy_node_count
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
 from ..transport.fifo import answer_fifo_path, command_fifo_path, fan_out
 from ..transport.wire import (
@@ -48,6 +51,21 @@ from ..utils.log import get_logger, set_verbosity
 from ..utils.timer import Timer
 
 log = get_logger(__name__)
+
+# head-side phase metrics (obs/__init__.py maps these against the
+# worker-side histograms and the wire stats fields)
+H_PREPARE = obs_metrics.histogram(
+    "head_prepare_seconds", "per-batch query-file write")
+H_SEND = obs_metrics.histogram(
+    "head_send_seconds",
+    "FIFO round-trip: request push until the stats line lands")
+H_PARTITION = obs_metrics.histogram(
+    "head_partition_seconds", "campaign partition/workload setup")
+H_SEARCH = obs_metrics.histogram(
+    "head_search_seconds", "in-process (TPU-mode) per-round search call")
+H_BATCHES = obs_metrics.counter("head_batches_total")
+H_BATCH_FAIL = obs_metrics.counter(
+    "head_batches_failed_total", "batches whose stats row came back FAIL")
 
 
 def runtime_config(args) -> RuntimeConfig:
@@ -318,13 +336,18 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
     # sequential rounds (the fused kernel serves the unlimited default).
     fused = None
     if not use_astar and len(diffs) > 1 and args.k_moves < 0:
-        with Timer() as fprep:
+        with Timer() as fprep, obs_trace.span("head.prepare", fused=True):
             w_list = [None if d == "-"
                       else graph.weights_with_diff(read_diff(d))
                       for d in diffs]
-        with Timer() as fsearch:
+        with Timer() as fsearch, obs_trace.span("head.search", fused=True,
+                                                rounds=len(diffs)):
             f_cost, f_plen, f_fin = oracle.query_multi(
                 queries, w_list, active_worker=args.worker)
+        # histogram stays per-round like the sequential path (and like
+        # the stats rows): one equal share per fused round
+        for _ in diffs:
+            H_SEARCH.observe(fsearch.interval / len(diffs))
         fused = (f_cost, f_plen, f_fin,
                  fprep.interval / len(diffs),
                  fsearch.interval / len(diffs))
@@ -338,7 +361,8 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
             cost, plen, fin = fused[0][di], fused[1], fused[2]
             prep_iv, search_iv = fused[3], fused[4]
         else:
-            with Timer() as prep:
+            with Timer() as prep, obs_trace.span("head.prepare",
+                                                 diff=diff):
                 w_query = (None if diff == "-"
                            else graph.weights_with_diff(read_diff(diff)))
             if use_astar:
@@ -346,7 +370,8 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
 
                 deadline = (_time.perf_counter() + time_ns / 1e9
                             if time_ns else None)
-                with Timer() as search:
+                with Timer() as search, obs_trace.span("head.search",
+                                                       alg="astar"):
                     cost = np.zeros(len(queries), np.int64)
                     plen = np.zeros(len(queries), np.int64)
                     fin = np.zeros(len(queries), bool)
@@ -362,11 +387,13 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
                             args.h_scale, args.f_scale, deadline)
                     cost[active], plen[active], fin[active] = c, p, f
             else:
-                with Timer() as search:
+                with Timer() as search, obs_trace.span(
+                        "head.search", alg="table-search", diff=diff):
                     cost, plen, fin = oracle.query(
                         queries, w_query=w_query, k_moves=args.k_moves,
                         active_worker=args.worker)
             prep_iv, search_iv = prep.interval, search.interval
+            H_SEARCH.observe(search_iv)
         total_moves = int(plen[active].sum())
         total_size = int(active.sum())
         rows = []
@@ -421,18 +448,30 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
 
 def send_queries(host: str, wid: int, part: np.ndarray, rconf: RuntimeConfig,
                  nfs: str, diff: str, t_partition: float = 0.0,
-                 timeout: float | None = fifo_transport.DEFAULT_TIMEOUT
-                 ) -> list:
+                 timeout: float | None = fifo_transport.DEFAULT_TIMEOUT,
+                 trace_id: str = "") -> list:
     """One worker's batch: write the query file, push the request through
     the command FIFO, read the stats line (parity: reference
-    ``process_query.py:82-111``)."""
-    with Timer() as prep:
+    ``process_query.py:82-111``). A non-empty ``trace_id`` stamps the
+    batch's head-side spans AND rides the wire so the worker captures its
+    half under the same id."""
+    with Timer() as prep, obs_trace.span("head.prepare", wid=wid,
+                                         trace_id=trace_id):
         qfile = os.path.join(nfs, f"query.{host}{wid}")
         write_query_file(qfile, part)
+    H_PREPARE.observe(prep.interval)
+    if trace_id:
+        rconf = dataclasses.replace(rconf, trace_id=trace_id)
     req = Request(rconf, qfile, answer_fifo_path(nfs, host, wid), diff)
-    row = fifo_transport.send_with_retry(host, req, command_fifo_path(wid),
-                                         timeout=timeout)
+    with Timer() as send, obs_trace.span("head.send", wid=wid, diff=diff,
+                                         trace_id=trace_id):
+        row = fifo_transport.send_with_retry(host, req,
+                                             command_fifo_path(wid),
+                                             timeout=timeout)
+    H_SEND.observe(send.interval)
+    H_BATCHES.inc()
     if not row.ok:
+        H_BATCH_FAIL.inc()
         log.error("worker %d on %s failed; marking row failed", wid, host)
     return row.as_list(t_prepare=prep.interval, t_partition=t_partition,
                        size=len(part))
@@ -447,15 +486,32 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
     # budget extends the transport allowance proportionally
     timeout = max(fifo_transport.DEFAULT_TIMEOUT,
                   (get_time_ns(args) / 1e9) * 10)
+    # per-batch trace ids: campaign id + worker + round, stamped on the
+    # head spans and propagated over the wire (obs.trace wire extension)
+    tracing = obs_trace.enabled()
+    base_tid = (obs_trace.current_trace_id()
+                or obs_trace.new_trace_id()) if tracing else ""
     stats = []
     paths = None
-    for diff in diffs:
+    for di, diff in enumerate(diffs):
         jobs = [(conf.workers[wid], wid, part) for wid, part in
                 sorted(groups.items())]
         rows = fan_out(jobs, lambda j: send_queries(
             j[0], j[1], j[2], rconf, conf.nfs, diff,
-            t_partition=t_partition, timeout=timeout))
+            t_partition=t_partition, timeout=timeout,
+            trace_id=f"{base_tid}/w{j[1]}.d{di}" if tracing else ""))
         stats.append(rows)
+        if tracing:
+            # merge the workers' span sidecars for this round (absent
+            # when a worker predates the wire extension — skip quietly)
+            for host, wid, part in jobs:
+                sidecar = obs_trace.trace_sidecar_for(
+                    os.path.join(conf.nfs, f"query.{host}{wid}"))
+                try:
+                    obs_trace.ingest(obs_trace.read_events(sidecar))
+                    os.remove(sidecar)
+                except (OSError, ValueError):
+                    log.debug("no trace sidecar from worker %d", wid)
         if rconf.extract and paths is None:
             # prefixes follow free-flow moves -> diff-invariant; collect
             # each worker's .paths file from the first round only
@@ -493,15 +549,16 @@ def run(conf: ClusterConfig, args):
             "the reordered files (build + serve then agree by "
             "construction).")
     scen = conf.scenfile or args.scenario
-    with Timer() as t_read:
+    with Timer() as t_read, obs_trace.span("head.read", scen=scen):
         queries = read_scen(scen)
     log.info("read %d queries from %s", len(queries), scen)
 
-    with Timer() as t_workload:
+    with Timer() as t_workload, obs_trace.span("head.partition"):
         partmethod, partkey = effective_partition(conf, args)
         nodenum = xy_node_count(conf.xy_file)
         dc = DistributionController(partmethod, partkey, conf.maxworker,
                                     nodenum)
+    H_PARTITION.observe(t_workload.interval)
     diffs = list(conf.diffs) if conf.diffs else list(args.diffs)
 
     use_tpu = args.backend == "tpu" or (args.backend == "auto"
@@ -556,6 +613,11 @@ def output(data, stats, args, paths=None) -> None:
         writer.writerow(STATS_HEADER)
         writer.writerows([i, *row] for i, expe in enumerate(stats)
                          for row in expe)
+    # obs snapshot next to the stats CSV: the campaign's counters and
+    # per-phase histograms (obs.metrics), complementing the coarse
+    # phase timings in metrics.json
+    obs_metrics.REGISTRY.dump_json(
+        os.path.join(dirname, "obs_metrics.json"))
     if paths is not None:
         k = paths.shape[1] - 4
         with open(os.path.join(dirname, "paths.csv"), "w") as f:
@@ -581,12 +643,31 @@ def test(args):
     return data, stats
 
 
+def _finish_obs(args) -> None:
+    """Write the ``--trace`` / ``--metrics-dump`` artifacts (primary
+    process only — every controller ran the identical campaign)."""
+    if not is_primary():
+        return
+    trace_path = getattr(args, "trace", "")
+    if trace_path:
+        obs_trace.write_trace(trace_path)
+        log.info("wrote %d trace events to %s (open in Perfetto)",
+                 len(obs_trace.events()), trace_path)
+    dump = getattr(args, "metrics_dump", "")
+    if dump:
+        obs_metrics.REGISTRY.dump_json(dump)
+        log.info("wrote metrics snapshot to %s", dump)
+
+
 def main(argv=None) -> int:
     args = parse_args(argv, prog="process_query")
     set_verbosity(args.verbose)
     if args.debug:
         # deterministic repro mode (parity: reference offline.py:143-147)
         args.omp, args.verbose = 1, max(args.verbose, 2)
+    if getattr(args, "trace", ""):
+        obs_trace.enable()
+        obs_trace.set_trace_id(obs_trace.new_trace_id())
     import contextlib
     if args.profile:
         import jax
@@ -596,6 +677,7 @@ def main(argv=None) -> int:
     with trace:
         if args.test:
             test(args)
+            _finish_obs(args)
             return 0
         conf = ClusterConfig.load(args.c)
         data, stats, paths = run(conf, args)
@@ -603,6 +685,7 @@ def main(argv=None) -> int:
         # only process 0 writes/prints the shared artifacts
         if is_primary():
             output(data, stats, args, paths)
+        _finish_obs(args)
     return 0
 
 

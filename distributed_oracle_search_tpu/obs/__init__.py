@@ -1,0 +1,52 @@
+"""Observability: metrics registry + span tracing for the query path.
+
+The serving system fans query batches from a head node to shard-owning
+workers (``cli.process_query`` → FIFO wire → ``worker.server`` →
+``worker.engine``); this package is the standing instrumentation layer
+every perf/robustness change reports through:
+
+* :mod:`.metrics` — thread-safe counters / gauges / histograms with JSON
+  snapshot and Prometheus text exposition (``--metrics-dump PATH``, and
+  ``bench.py`` embeds a snapshot in ``BENCH_DETAIL.json``);
+* :mod:`.trace` — nested span tracing exporting Chrome trace-event JSON
+  (``--trace PATH``, open in Perfetto), with a per-batch ``trace_id``
+  propagated head→worker as a ``RuntimeConfig`` wire extension so both
+  sides of one batch join on a single timeline.
+
+Mapping to the reference paper's per-batch stats fields (the wire CSV,
+``transport.wire.ENGINE_STAT_FIELDS``) — the histograms decompose what
+the reference reports only as three wall-clock totals:
+
+=============  =====================================================
+stats field    obs metrics covering the same interval
+=============  =====================================================
+``t_receive``  ``worker_receive_seconds`` — batch prep INCLUDING the
+               weights load; ``worker_weights_load_seconds`` is the
+               contained sub-phase (diff read + device upload), NOT an
+               additional interval. The query-file read happens in the
+               server, outside the engine's timers, and appears as the
+               ``worker.receive`` span only.
+``t_astar``    ``worker_search_seconds`` (the search call itself;
+               first-call XLA compile time is split out into
+               ``worker_jit_compile_seconds`` so steady-state latency
+               is not polluted by one-time compilation)
+``t_search``   receive + search — the worker's whole batch; the
+               head-side view of the same batch is
+               ``head_prepare_seconds`` + ``head_send_seconds``
+               (FIFO round-trip, includes the worker's t_search)
+=============  =====================================================
+
+Server failure paths (no stats-field analog — the reference dropped
+these on the floor): ``server_frames_received_total``,
+``server_frames_malformed_total``, ``server_frames_half_total``,
+``server_replies_dropped_total``, ``server_batches_failed_total``, and
+``server_reply_open_wait_seconds`` (how long replies waited for the
+head's answer-FIFO reader).
+"""
+
+from . import metrics, trace
+from .metrics import REGISTRY, counter, gauge, histogram
+from .trace import span
+
+__all__ = ["metrics", "trace", "REGISTRY", "counter", "gauge",
+           "histogram", "span"]

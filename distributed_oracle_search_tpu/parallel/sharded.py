@@ -39,6 +39,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import DeviceGraph, table_search_batch
 from .mesh import WORKER_AXIS, DATA_AXIS, replicated
 
+# jax moved shard_map to the top-level namespace after 0.4.x; older
+# releases only ship the experimental spelling, whose replication
+# checker cannot handle the relaxation while_loops (check_rep=False is
+# the documented workaround and a no-op for correctness here: every
+# out_spec names the worker axis explicitly)
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on older jax
+    from jax.experimental.shard_map import shard_map as _xshard_map
+
+    def _shard_map(f, **kwargs):
+        kwargs.setdefault("check_rep", False)
+        return _xshard_map(f, **kwargs)
+
 
 def pad_targets(controller, dtype=np.int32) -> np.ndarray:
     """[W, R] owned targets per worker, -1-padded to the max shard size."""
@@ -118,7 +132,7 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
         return fm[None]
 
     out_spec = P(WORKER_AXIS, None, None)
-    sm = jax.shard_map(
+    sm = _shard_map(
         _local, mesh=mesh,
         in_specs=(P(), *([P()] * n_kernel_ops), P(None, WORKER_AXIS)),
         out_specs=(out_spec, out_spec) if with_dists else out_spec,
@@ -209,7 +223,7 @@ def _tables_fn(mesh: Mesh, max_len: int):
         return doubled_tables(dg, fm_local[0], tgt_local[0], w_pad,
                               max_len=max_len)
 
-    sm = jax.shard_map(
+    sm = _shard_map(
         _local, mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS, None, None), P(WORKER_AXIS, None),
                   P()),
@@ -247,7 +261,7 @@ def _tables_multi_fn(mesh: Mesh, max_len: int):
         return doubled_tables_multi(dg, fm_local[0], tgt_local[0],
                                     w_pads, max_len=max_len)
 
-    sm = jax.shard_map(
+    sm = _shard_map(
         _local, mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS, None, None), P(WORKER_AXIS, None),
                   P()),
@@ -293,7 +307,7 @@ def _query_table_multi_fn(mesh: Mesh, d: int):
                                       valid.reshape(-1))
         return (c.reshape(d, *shape), p.reshape(shape), f.reshape(shape))
 
-    sm = jax.shard_map(
+    sm = _shard_map(
         _local, mesh=mesh,
         in_specs=(P(WORKER_AXIS, None, None, None),
                   P(WORKER_AXIS, None, None), q3, q3, q3),
@@ -324,7 +338,7 @@ def _query_table_fn(mesh: Mesh):
         return c.reshape(shape), p.reshape(shape), f.reshape(shape)
 
     t3 = P(WORKER_AXIS, None, None)
-    sm = jax.shard_map(_local, mesh=mesh,
+    sm = _shard_map(_local, mesh=mesh,
                        in_specs=(t3, t3, q3, q3, q3),
                        out_specs=(q3, q3, q3))
     return jax.jit(sm)
@@ -352,7 +366,7 @@ def _paths_fn(mesh: Mesh, k: int):
                                     s.reshape(-1), t.reshape(-1), k=k)
         return (nodes.reshape(*shape, k + 1), plen.reshape(shape))
 
-    sm = jax.shard_map(
+    sm = _shard_map(
         _local, mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3),
         out_specs=(P(DATA_AXIS, WORKER_AXIS, None, None), q3),
@@ -386,7 +400,7 @@ def _query_dist_fn(mesh: Mesh):
         cost = dist_local[0][rows.reshape(-1), s.reshape(-1)]
         return cost.reshape(shape)
 
-    sm = jax.shard_map(_local, mesh=mesh,
+    sm = _shard_map(_local, mesh=mesh,
                        in_specs=(P(WORKER_AXIS, None, None), q3, q3),
                        out_specs=q3)
     return jax.jit(sm)
@@ -421,7 +435,7 @@ def _query_fn(mesh: Mesh, max_steps: int, k_moves: int = -1):
             valid=valid.reshape(-1), k_moves=k_moves, max_steps=max_steps)
         return (cost.reshape(shape), plen.reshape(shape), fin.reshape(shape))
 
-    sm = jax.shard_map(
+    sm = _shard_map(
         _local, mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, P()),
         out_specs=(q3, q3, q3),
@@ -444,7 +458,7 @@ def _query_multi_fn(mesh: Mesh, max_steps: int, d: int):
         return (cost.reshape(d, *shape), plen.reshape(shape),
                 fin.reshape(shape))
 
-    sm = jax.shard_map(
+    sm = _shard_map(
         _local, mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, P()),
         out_specs=(P(None, DATA_AXIS, WORKER_AXIS, None), q3, q3),

@@ -56,6 +56,13 @@ class RuntimeConfig:
     they ride the shared dir, keeping the stats CSV wire unchanged).
     Servers that predate the key ignore it (``from_json`` filters unknown
     keys symmetrically).
+
+    ``trace_id`` is the observability wire extension (``obs.trace``): a
+    non-empty id asks the server to capture its spans for this batch and
+    materialize them as ``<queryfile>.trace`` for the head to merge —
+    the head's and worker's halves of one batch join on this id. Same
+    compat contract as ``extract``: old peers filter the unknown key,
+    and ``""`` (the default) disables capture.
     """
 
     hscale: float = 1.0
@@ -69,6 +76,7 @@ class RuntimeConfig:
     thread_alloc: int = 0
     no_cache: bool = False
     extract: bool = False
+    trace_id: str = ""
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

@@ -4,13 +4,43 @@ The reference drives a module logger off a counted ``-v`` flag
 (``args.py:7,190-196``); we do the same but per-named-logger and without
 touching the host application's root logger at import time (library
 convention: handlers are attached to our own namespace only).
+
+Multi-worker runs interleave all workers' records on one stream (N
+servers on one host in the smoke modes, or ssh-forwarded stderr on a
+cluster), so every record carries a worker id: ``set_worker_id`` tags
+the **current thread** (each ``FifoServer.serve_forever`` loop is one
+thread, and the engine logs from the same thread), and the handler's
+filter stamps ``[w<id>]`` into the format — ``-`` for head-side /
+untagged threads.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 
 _ROOT = "dos_tpu"
+
+_ctx = threading.local()
+
+
+def set_worker_id(wid: int | str | None) -> None:
+    """Tag this thread's subsequent log records with a worker id
+    (``None`` untags)."""
+    _ctx.wid = wid
+
+
+def get_worker_id() -> int | str | None:
+    return getattr(_ctx, "wid", None)
+
+
+class _WorkerIdFilter(logging.Filter):
+    """Stamp the thread's worker id onto every record (``-`` if unset)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        wid = getattr(_ctx, "wid", None)
+        record.worker = "-" if wid is None else wid
+        return True
 
 
 def get_logger(name: str = "") -> logging.Logger:
@@ -21,7 +51,9 @@ def _ensure_handler(root: logging.Logger) -> None:
     if not root.handlers:
         handler = logging.StreamHandler()
         handler.setFormatter(logging.Formatter(
-            "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+            "%(asctime)s %(name)s [w%(worker)s] %(levelname)s: "
+            "%(message)s"))
+        handler.addFilter(_WorkerIdFilter())
         root.addHandler(handler)
         root.propagate = False
 

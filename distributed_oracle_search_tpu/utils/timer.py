@@ -19,19 +19,34 @@ class Timer:
     ...     do_work()
     >>> t.interval      # seconds (float)
     >>> t.interval_ns   # integer nanoseconds
+
+    ``interval`` is only set on block exit (it reads 0.0 mid-block);
+    ``elapsed`` also works inside the ``with`` block, returning the time
+    since entry, and equals ``interval`` after exit.
     """
 
-    __slots__ = ("interval", "_start")
+    __slots__ = ("interval", "_start", "_running")
 
     def __init__(self, interval: float = 0.0):
         self.interval = float(interval)
+        self._running = False
 
     def __enter__(self) -> "Timer":
         self._start = time.perf_counter()
+        self._running = True
         return self
 
     def __exit__(self, *exc) -> None:
         self.interval = time.perf_counter() - self._start
+        self._running = False
+
+    @property
+    def elapsed(self) -> float:
+        """Seconds since block entry while inside the ``with`` block;
+        the final ``interval`` once the block has exited."""
+        if self._running:
+            return time.perf_counter() - self._start
+        return self.interval
 
     @property
     def interval_ns(self) -> int:

@@ -32,11 +32,29 @@ import numpy as np
 
 from ..data.formats import read_diff
 from ..data.graph import Graph
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
 from ..transport.wire import RuntimeConfig, StatsRow
-from ..utils.log import get_logger
+from ..utils.log import get_logger, set_worker_id
 
 log = get_logger(__name__)
+
+# declared at import so a snapshot shows the engine's phase histograms
+# even before the first batch (obs/__init__.py maps these to the wire
+# stats fields t_receive/t_astar/t_search)
+M_RECEIVE = obs_metrics.histogram(
+    "worker_receive_seconds", "batch prep incl. weights (t_receive)")
+M_WEIGHTS = obs_metrics.histogram(
+    "worker_weights_load_seconds", "diff read + device weight upload")
+M_SEARCH = obs_metrics.histogram(
+    "worker_search_seconds", "steady-state search call (t_astar)")
+M_JIT = obs_metrics.histogram(
+    "worker_jit_compile_seconds",
+    "first call at a new (alg, shape, knobs) key — XLA compile + run, "
+    "split out so steady-state latency stays clean")
+M_BATCHES = obs_metrics.counter("worker_batches_total")
+M_QUERIES = obs_metrics.counter("worker_queries_total")
 
 
 def load_shard_rows(outdir: str, wid: int) -> np.ndarray:
@@ -78,6 +96,11 @@ class ShardEngine:
             self.fm = None
         self.dg = DeviceGraph.from_graph(graph)
         self._weight_cache: dict[str, object] = {}
+        #: (alg, qpad, knobs) keys whose program has already run once —
+        #: the first call at a new key pays XLA compilation and is
+        #: recorded to ``worker_jit_compile_seconds`` instead of the
+        #: steady-state ``worker_search_seconds`` histogram
+        self._jit_seen: set[tuple] = set()
         #: device-resident graph arrays for the batched A* serving path
         #: (in-ELL, coords, per-diff padded weights) — uploaded once, not
         #: per request (ops.batched_astar ctx contract)
@@ -116,9 +139,13 @@ class ShardEngine:
         import jax.numpy as jnp
         from ..ops.table_search import extract_paths, table_search_batch
 
+        set_worker_id(self.wid)
         t0 = time.perf_counter()
         self.last_paths = None
-        w_pad = self._weights_for(difffile, config.no_cache)
+        with obs_trace.span("worker.weights", wid=self.wid,
+                            difffile=difffile):
+            w_pad = self._weights_for(difffile, config.no_cache)
+        M_WEIGHTS.observe(time.perf_counter() - t0)
         nq = len(queries)
         if nq == 0:
             if config.extract and config.k_moves > 0:
@@ -156,6 +183,27 @@ class ShardEngine:
                 "workers — routing invariant violated")
 
         t1 = time.perf_counter()
+        M_RECEIVE.observe(t1 - t0)
+        # the compile/steady split keys on the COMPILED PROGRAM's shape:
+        # the chunked paths (astar always; table-search under a time
+        # budget once the batch exceeds one chunk) reuse a chunk-wide
+        # program across batch sizes, so a bigger qpad alone is not a
+        # recompile — except with --extract, whose extraction program
+        # does compile at the full qpad (kept in the key, conservative)
+        extracting = config.extract and config.k_moves > 0
+        if self.alg == "astar":
+            # the astar program depends only on its chunk shape: hscale/
+            # fscale are traced scalars and k_moves/extract never reach
+            # it (reference args.py:28), so they stay out of the key
+            jit_key = ("astar", min(qpad, self.astar_chunk))
+        else:
+            if (config.time and qpad > self.astar_chunk
+                    and not extracting):
+                shape_key = self.astar_chunk
+            else:
+                shape_key = qpad
+            jit_key = (self.alg, shape_key, config.k_moves, extracting)
+        first_call = jit_key not in self._jit_seen
         if self.alg == "astar":
             deadline = t1 + config.time / 1e9 if config.time else None
             for _ in range(max(config.itrs, 1)):
@@ -164,6 +212,7 @@ class ShardEngine:
                 if deadline is not None and time.perf_counter() > deadline:
                     break
             t2 = time.perf_counter()
+            self._finish_search(jit_key, first_call, nq, t2 - t1)
             stats = StatsRow(
                 **counters, t_receive=t1 - t0, t_astar=t2 - t1,
                 t_search=t2 - t0)
@@ -225,6 +274,7 @@ class ShardEngine:
                 np.asarray(nodes[:nq], np.int64)[unsort],
                 np.asarray(moves[:nq], np.int64)[unsort])
         t2 = time.perf_counter()
+        self._finish_search(jit_key, first_call, nq, t2 - t1)
 
         cost = np.asarray(cost[:nq], np.int64)[unsort]
         plen = np.asarray(plen[:nq], np.int64)[unsort]
@@ -239,6 +289,19 @@ class ShardEngine:
             t_search=t2 - t0,
         )
         return cost, plen, fin, stats
+
+    def _finish_search(self, jit_key: tuple, first_call: bool, nq: int,
+                       seconds: float) -> None:
+        """Book one batch's search interval: first call at a new program
+        key goes to the compile histogram (XLA compilation dominates it),
+        repeats to the steady-state one; the span mirrors the split."""
+        self._jit_seen.add(jit_key)
+        (M_JIT if first_call else M_SEARCH).observe(seconds)
+        M_BATCHES.inc()
+        M_QUERIES.inc(nq)
+        obs_trace.add_span("worker.search", seconds, wid=self.wid,
+                           alg=self.alg, queries=nq,
+                           first_call=first_call)
 
     def _raw_weights_for(self, difffile: str, no_cache: bool):
         """Raw (unpadded) query weights + heuristic scale, cached per diff

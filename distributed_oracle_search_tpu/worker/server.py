@@ -25,18 +25,42 @@ import sys
 import numpy as np
 
 from ..data.graph import Graph
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
 from ..transport.wire import (
     Request, StatsRow, paths_file_for, read_query_file, write_paths_file,
 )
 from ..transport.fifo import command_fifo_path
 from ..utils.config import ClusterConfig
-from ..utils.log import get_logger, set_verbosity
+from ..utils.log import get_logger, set_verbosity, set_worker_id
 from .engine import ShardEngine
 
 log = get_logger(__name__)
 
 STOP_TOKEN = "__DOS_STOP__"
+
+# serve-loop health counters, declared at import so a snapshot shows the
+# failure paths at zero even when they never fired (the reference had no
+# visibility into any of these — frames and replies just vanished)
+M_FRAMES = obs_metrics.counter(
+    "server_frames_received_total", "frame starts seen on the command FIFO")
+M_MALFORMED = obs_metrics.counter(
+    "server_frames_malformed_total",
+    "stray non-frame lines + undecodable 2-line requests")
+M_HALF = obs_metrics.counter(
+    "server_frames_half_total",
+    "frames whose second line never arrived (timeout or config-only)")
+M_BATCH_FAIL = obs_metrics.counter(
+    "server_batches_failed_total", "engine exceptions answered with FAIL")
+M_REPLIES = obs_metrics.counter(
+    "server_replies_sent_total", "stats lines written to answer FIFOs")
+M_DROPPED = obs_metrics.counter(
+    "server_replies_dropped_total",
+    "replies dropped: no reader within the deadline, or reader vanished")
+M_REPLY_WAIT = obs_metrics.histogram(
+    "server_reply_open_wait_seconds",
+    "time a reply waited for the head to open its answer-FIFO reader")
 
 
 class FifoServer:
@@ -62,7 +86,26 @@ class FifoServer:
         os.mkfifo(self.command_fifo)
 
     def handle(self, req: Request) -> StatsRow:
-        queries = read_query_file(req.queryfile)
+        if req.config.trace_id:
+            # wire extension (obs.trace): the head stamped this batch
+            # with a trace id — capture our spans under it and ship them
+            # back as a sidecar next to the query file, like .paths
+            with obs_trace.capture(req.config.trace_id) as cap:
+                stats = self._handle(req)
+            try:
+                obs_trace.write_events(
+                    obs_trace.trace_sidecar_for(req.queryfile),
+                    cap.events)
+            except OSError as e:
+                log.error("cannot write trace sidecar for %s: %s",
+                          req.queryfile, e)
+            return stats
+        return self._handle(req)
+
+    def _handle(self, req: Request) -> StatsRow:
+        with obs_trace.span("worker.receive", wid=self.wid,
+                            queryfile=req.queryfile):
+            queries = read_query_file(req.queryfile)
         _, _, _, stats = self.engine.answer(queries, req.config,
                                             req.difffile)
         if self.engine.last_paths is not None:
@@ -88,6 +131,7 @@ class FifoServer:
         is written atomically, so frames can never interleave.
         """
         self._ensure_fifo()
+        set_worker_id(self.wid)      # tag this serve thread's log records
         log.info("worker %d serving on %s", self.wid, self.command_fifo)
         fd = os.open(self.command_fifo, os.O_RDWR)
         self._rdbuf = b""
@@ -99,6 +143,7 @@ class FifoServer:
                     return
                 if not line1.strip():
                     continue
+                M_FRAMES.inc()
                 if not line1.lstrip().startswith("{"):
                     # frame starts are self-identifying: a config line is
                     # always a JSON object, a paths line never is. A stray
@@ -106,6 +151,7 @@ class FifoServer:
                     # it can NEVER pair with (and eat) the next writer's
                     # config line; best-effort FAIL any FIFO it names
                     log.error("stray non-frame line: %r", line1)
+                    M_MALFORMED.inc()
                     self._answer_malformed(line1)
                     continue
                 # a legit writer ships both lines in ONE atomic write, so
@@ -115,6 +161,7 @@ class FifoServer:
                 if line2 is None:
                     log.error("half frame (no line 2 within %.1fs): %r",
                               self.FRAME_TIMEOUT_S, line1)
+                    M_HALF.inc()
                     continue
                 if STOP_TOKEN in line2:
                     # a stop chasing a truncated 1-line request must
@@ -126,6 +173,7 @@ class FifoServer:
                     # previous writer truncated. Push it back to start the
                     # next frame instead of corrupting two requests
                     log.error("config-only half frame: %r", line1)
+                    M_HALF.inc()
                     self._rdbuf = line2.encode() + self._rdbuf
                     continue
                 text = line1 + line2
@@ -133,6 +181,7 @@ class FifoServer:
                     req = Request.decode(text)
                 except ValueError as e:
                     log.error("bad request: %s", e)
+                    M_MALFORMED.inc()
                     self._answer_malformed(text)
                     continue
                 try:
@@ -140,6 +189,7 @@ class FifoServer:
                 except Exception as e:  # noqa: BLE001 — never leave
                     # the head blocked on `cat answer`; send a failure
                     log.exception("batch failed: %s", e)
+                    M_BATCH_FAIL.inc()
                     stats = StatsRow.failed()
                 self._reply(req.answerfifo, stats.encode_wire() + "\n")
         finally:
@@ -206,7 +256,8 @@ class FifoServer:
 
         wait_s = (deadline_s if deadline_s is not None
                   else self.reply_deadline_s)
-        deadline = _time.monotonic() + wait_s
+        t_wait0 = _time.monotonic()
+        deadline = t_wait0 + wait_s
         fd = -1
         while fd < 0:
             try:
@@ -214,22 +265,27 @@ class FifoServer:
             except OSError as e:
                 if e.errno not in (errno.ENXIO, errno.ENOENT):
                     log.error("cannot open %s: %s", answerfifo, e)
+                    M_DROPPED.inc()
                     return
                 if _time.monotonic() > deadline:
                     log.error("no reader on %s within %.0fs; dropping "
                               "reply", answerfifo, wait_s)
+                    M_DROPPED.inc()
                     return
                 _time.sleep(0.05)
+        M_REPLY_WAIT.observe(_time.monotonic() - t_wait0)
         try:
             # reader present: restore blocking mode for the write itself
             import fcntl
             fcntl.fcntl(fd, fcntl.F_SETFL,
                         fcntl.fcntl(fd, fcntl.F_GETFL) & ~os.O_NONBLOCK)
             os.write(fd, line.encode())
+            M_REPLIES.inc()
         except OSError as e:
             # reader vanished between open and write (BrokenPipe):
             # drop the reply, never crash the serve loop
             log.error("reply to %s failed: %s", answerfifo, e)
+            M_DROPPED.inc()
         finally:
             os.close(fd)
 
@@ -283,13 +339,21 @@ def main(argv=None) -> int:
                         "table-search, make_fifos.py:20; astar serves the "
                         "hscale/fscale family)")
     p.add_argument("-v", "--verbose", action="count", default=0)
+    p.add_argument("--metrics-dump", default="",
+                   help="write a JSON metrics snapshot (obs.metrics) to "
+                        "this path on clean shutdown")
     args = p.parse_args(argv)
     set_verbosity(args.verbose)
+    set_worker_id(args.workerid)
 
     conf = ClusterConfig.load(args.c)
     server = FifoServer(conf, args.workerid, command_fifo=args.fifo,
                         alg=args.alg)
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    finally:
+        if args.metrics_dump:
+            obs_metrics.REGISTRY.dump_json(args.metrics_dump)
     return 0
 
 

@@ -26,6 +26,16 @@ def test_runtime_config_ignores_unknown_keys():
     assert rc.hscale == 2.0
 
 
+def test_runtime_config_trace_id_wire_extension():
+    """trace_id rides the wire like extract does: preserved by a new
+    peer, defaulted when an old-schema peer omits it (the symmetric
+    unknown-key filter keeps both directions compatible)."""
+    rc = RuntimeConfig(trace_id="deadbeef/w1.d0")
+    assert RuntimeConfig.from_json(rc.to_json()).trace_id == \
+        "deadbeef/w1.d0"
+    assert RuntimeConfig.from_json('{"hscale": 1.0}').trace_id == ""
+
+
 def test_request_roundtrip():
     req = Request(RuntimeConfig(), "/nfs/query.host3", "/nfs/answer.host3",
                   "/data/melb.diff")
