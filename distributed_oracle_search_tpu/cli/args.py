@@ -122,6 +122,14 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                       help="Diff files for congestion; '-' = free flow.")
     fifo.add_argument("--no-cache", action="store_true",
                       help="Disable the workers' runtime cache.")
+    fifo.add_argument("--supervise", action="store_true",
+                      help="make_fifos: stay resident as a worker "
+                           "supervisor — launch the servers as "
+                           "subprocesses, ping them via the "
+                           "__DOS_PING__ liveness frame, and respawn "
+                           "crashed ones with capped exponential "
+                           "backoff (local hosts only; see "
+                           "worker.supervisor).")
     fifo.add_argument("--alg", default="table-search",
                       choices=["table-search", "astar", "ch"],
                       help="Serving algorithm — honored by BOTH backends "

@@ -83,6 +83,19 @@ def main(argv=None) -> int:
         raise SystemExit("--alg ch is served by the native engine "
                          "(contraction hierarchies, native/src/ch.hpp); "
                          "add --engine native")
+    if args.supervise:
+        from ..transport.launch import LOCAL_HOSTS
+        from ..worker.supervisor import supervise_forever
+        if args.engine != "python":
+            raise SystemExit("--supervise manages python worker.server "
+                             "subprocesses (the native engine has no "
+                             "supervised launch yet)")
+        remote = [h for h in conf.workers if h not in LOCAL_HOSTS]
+        if remote:
+            raise SystemExit(f"--supervise is local-only; conf names "
+                             f"remote hosts {sorted(set(remote))} — run "
+                             f"the supervisor on each worker host")
+        return supervise_forever(conf, conf_path, alg=args.alg)
     procs = []
     for wid in range(conf.maxworker):
         if args.worker != -1 and wid != args.worker:

@@ -46,11 +46,21 @@ from ..transport.wire import (
 )
 from ..parallel.multihost import is_primary
 from ..transport import fifo as fifo_transport
+from ..transport import resilience
 from ..utils.config import ClusterConfig, test_config
+from ..utils.env import env_cast
 from ..utils.log import get_logger, set_verbosity
 from ..utils.timer import Timer
 
 log = get_logger(__name__)
+
+#: campaign exit codes — distinct so operators and CI can tell a fully
+#: clean run from a degraded one (partial results + degraded.json) and
+#: from a total failure (no batch succeeded). 1 and 2 are left to Python
+#: tracebacks and argparse respectively.
+EXIT_CLEAN = 0
+EXIT_DEGRADED = 3
+EXIT_FAILED = 4
 
 # head-side phase metrics (obs/__init__.py maps these against the
 # worker-side histograms and the wire stats fields)
@@ -449,12 +459,32 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
 def send_queries(host: str, wid: int, part: np.ndarray, rconf: RuntimeConfig,
                  nfs: str, diff: str, t_partition: float = 0.0,
                  timeout: float | None = fifo_transport.DEFAULT_TIMEOUT,
-                 trace_id: str = "") -> list:
+                 trace_id: str = "", round_idx: int = 0,
+                 policy: fifo_transport.RetryPolicy | None = None,
+                 registry: resilience.BreakerRegistry | None = None):
     """One worker's batch: write the query file, push the request through
     the command FIFO, read the stats line (parity: reference
     ``process_query.py:82-111``). A non-empty ``trace_id`` stamps the
     batch's head-side spans AND rides the wire so the worker captures its
-    half under the same id."""
+    half under the same id.
+
+    Returns ``(row_list, failure)`` where ``failure`` is None on success
+    or a dict describing the failed batch for the ``degraded.json``
+    manifest. An OPEN circuit breaker short-circuits the whole batch to
+    an instant failure row — no query file, no FIFO wait."""
+    def _failure(reason: str) -> dict:
+        return {"wid": wid, "host": host, "round": round_idx,
+                "diff": diff, "size": int(len(part)), "reason": reason}
+
+    key = (host, wid)
+    if registry is not None and not registry.allow(key):
+        log.error("circuit OPEN for worker %d on %s; batch "
+                  "short-circuited", wid, host)
+        H_BATCHES.inc()
+        H_BATCH_FAIL.inc()
+        row = StatsRow.failed()
+        return (row.as_list(t_prepare=0.0, t_partition=t_partition,
+                            size=len(part)), _failure("circuit-open"))
     with Timer() as prep, obs_trace.span("head.prepare", wid=wid,
                                          trace_id=trace_id):
         qfile = os.path.join(nfs, f"query.{host}{wid}")
@@ -467,25 +497,50 @@ def send_queries(host: str, wid: int, part: np.ndarray, rconf: RuntimeConfig,
                                          trace_id=trace_id):
         row = fifo_transport.send_with_retry(host, req,
                                              command_fifo_path(wid),
-                                             timeout=timeout)
+                                             timeout=timeout,
+                                             policy=policy, wid=wid)
     H_SEND.observe(send.interval)
     H_BATCHES.inc()
+    if registry is not None:
+        registry.record(key, row.ok)
     if not row.ok:
         H_BATCH_FAIL.inc()
         log.error("worker %d on %s failed; marking row failed", wid, host)
-    return row.as_list(t_prepare=prep.interval, t_partition=t_partition,
-                       size=len(part))
+    return (row.as_list(t_prepare=prep.interval,
+                        t_partition=t_partition, size=len(part)),
+            None if row.ok else _failure("send-failed"))
+
+
+def send_timeout_s(args) -> float:
+    """Transport timeout: independent of the per-query search budget (a
+    short ``--ms-lim`` must not kill the ssh/FIFO round-trip itself; a
+    long budget extends the transport allowance proportionally).
+    ``DOS_SEND_TIMEOUT_S`` overrides outright — chaos tests and operators
+    with known-fast batches use it to keep dead-worker detection far
+    below the 10-minute default."""
+    override = env_cast("DOS_SEND_TIMEOUT_S", None, float)
+    if override is not None:
+        return override
+    return max(fifo_transport.DEFAULT_TIMEOUT,
+               (get_time_ns(args) / 1e9) * 10)
 
 
 def run_host(conf: ClusterConfig, args, queries, dc, diffs,
              t_partition: float = 0.0):
     rconf = runtime_config(args)
     groups = dc.group_queries(queries, active_worker=args.worker)
-    # transport timeout is independent of the per-query search budget: a
-    # short --ms-lim must not kill the ssh/FIFO round-trip itself; a long
-    # budget extends the transport allowance proportionally
-    timeout = max(fifo_transport.DEFAULT_TIMEOUT,
-                  (get_time_ns(args) / 1e9) * 10)
+    timeout = send_timeout_s(args)
+    # fault-tolerance plumbing: stale FIFOs from crashed runs are swept
+    # before the first batch (a killed transfer script never reaches its
+    # `rm -f`), retries follow the env-tuned backoff policy, and each
+    # worker gets a circuit breaker whose background probes ping through
+    # the same command FIFO the batches use
+    fifo_transport.clean_stale_answer_fifos(conf.nfs)
+    policy = fifo_transport.RetryPolicy.from_env()
+    registry = resilience.BreakerRegistry(
+        probe_fn=lambda key: fifo_transport.probe(
+            key[0], key[1], command_fifo=command_fifo_path(key[1]),
+            nfs=conf.nfs))
     # per-batch trace ids: campaign id + worker + round, stamped on the
     # head spans and propagated over the wire (obs.trace wire extension)
     tracing = obs_trace.enabled()
@@ -493,13 +548,35 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
                 or obs_trace.new_trace_id()) if tracing else ""
     stats = []
     paths = None
+    failures = []
+    try:
+        stats, paths, failures = _run_host_rounds(
+            conf, args, dc, diffs, groups, rconf, t_partition, timeout,
+            tracing, base_tid, policy, registry)
+    finally:
+        registry.shutdown()
+    if failures:
+        log.error("campaign degraded: %d failed batch(es) across "
+                  "workers %s", len(failures),
+                  sorted({f["wid"] for f in failures}))
+    return stats, paths, failures
+
+
+def _run_host_rounds(conf, args, dc, diffs, groups, rconf, t_partition,
+                     timeout, tracing, base_tid, policy, registry):
+    stats = []
+    paths = None
+    failures = []
     for di, diff in enumerate(diffs):
         jobs = [(conf.workers[wid], wid, part) for wid, part in
                 sorted(groups.items())]
-        rows = fan_out(jobs, lambda j: send_queries(
+        results = fan_out(jobs, lambda j: send_queries(
             j[0], j[1], j[2], rconf, conf.nfs, diff,
             t_partition=t_partition, timeout=timeout,
-            trace_id=f"{base_tid}/w{j[1]}.d{di}" if tracing else ""))
+            trace_id=f"{base_tid}/w{j[1]}.d{di}" if tracing else "",
+            round_idx=di, policy=policy, registry=registry))
+        rows = [row for row, _failure in results]
+        failures.extend(f for _row, f in results if f is not None)
         stats.append(rows)
         if tracing:
             # merge the workers' span sidecars for this round (absent
@@ -529,7 +606,7 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
                     [part, moves[:, None], nodes], axis=1))
             if parts:
                 paths = np.concatenate(parts, axis=0)
-    return stats, paths
+    return stats, paths, failures
 
 
 # ------------------------------------------------------------------- driver
@@ -569,9 +646,11 @@ def run(conf: ClusterConfig, args):
     with Timer() as t_process:
         if use_tpu:
             stats, paths = run_tpu(conf, args, queries, dc, diffs)
+            failures = []   # in-process rounds have no per-worker wire
         else:
-            stats, paths = run_host(conf, args, queries, dc, diffs,
-                                    t_partition=t_workload.interval)
+            stats, paths, failures = run_host(
+                conf, args, queries, dc, diffs,
+                t_partition=t_workload.interval)
 
     data = {
         "num_queries": int(len(queries)),
@@ -579,8 +658,36 @@ def run(conf: ClusterConfig, args):
         "t_read": t_read.interval,
         "t_workload": t_workload.interval,
         "t_process": t_process.interval,
+        "failed_batches": failures,
     }
     return data, stats, paths
+
+
+def campaign_exit_code(data, stats) -> int:
+    """Clean / degraded / failed from the collected failure records."""
+    failures = data.get("failed_batches", [])
+    if not failures:
+        return EXIT_CLEAN
+    total = sum(len(expe) for expe in stats)
+    return EXIT_FAILED if len(failures) >= total else EXIT_DEGRADED
+
+
+def write_degraded_manifest(dirname: str, data, stats) -> str:
+    """``degraded.json`` next to the other campaign artifacts: which
+    batches failed, on which workers, and why — the machine-readable
+    companion of the non-zero exit code."""
+    failures = data.get("failed_batches", [])
+    manifest = {
+        "exit_code": campaign_exit_code(data, stats),
+        "total_batches": sum(len(expe) for expe in stats),
+        "failed_count": len(failures),
+        "failed_workers": sorted({f["wid"] for f in failures}),
+        "failed_batches": failures,
+    }
+    path = os.path.join(dirname, "degraded.json")
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1)
+    return path
 
 
 def output(data, stats, args, paths=None) -> None:
@@ -618,6 +725,9 @@ def output(data, stats, args, paths=None) -> None:
     # phase timings in metrics.json
     obs_metrics.REGISTRY.dump_json(
         os.path.join(dirname, "obs_metrics.json"))
+    if data.get("failed_batches"):
+        path = write_degraded_manifest(dirname, data, stats)
+        log.error("degraded campaign: manifest written to %s", path)
     if paths is not None:
         k = paths.shape[1] - 4
         with open(os.path.join(dirname, "paths.csv"), "w") as f:
@@ -676,9 +786,9 @@ def main(argv=None) -> int:
         trace = contextlib.nullcontext()
     with trace:
         if args.test:
-            test(args)
+            data, stats = test(args)
             _finish_obs(args)
-            return 0
+            return campaign_exit_code(data, stats)
         conf = ClusterConfig.load(args.c)
         data, stats, paths = run(conf, args)
         # multi-controller: every process runs the identical campaign;
@@ -686,7 +796,15 @@ def main(argv=None) -> int:
         if is_primary():
             output(data, stats, args, paths)
         _finish_obs(args)
-    return 0
+    code = campaign_exit_code(data, stats)
+    if code != EXIT_CLEAN:
+        log.error("campaign finished %s (exit %d): %d/%d batches failed%s",
+                  "DEGRADED" if code == EXIT_DEGRADED else "FAILED",
+                  code, len(data.get("failed_batches", [])),
+                  sum(len(expe) for expe in stats),
+                  f"; manifest at {os.path.join(args.output, 'degraded.json')}"
+                  if args.output else "")
+    return code
 
 
 if __name__ == "__main__":

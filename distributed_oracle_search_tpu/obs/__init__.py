@@ -42,6 +42,23 @@ these on the floor): ``server_frames_received_total``,
 ``server_replies_dropped_total``, ``server_batches_failed_total``, and
 ``server_reply_open_wait_seconds`` (how long replies waited for the
 head's answer-FIFO reader).
+
+Fault-tolerance layer (PR 2 — every recovery path proves it fired
+through one of these):
+
+* head retries / circuit breaking — ``head_retries_total``,
+  ``head_circuit_open_total``, ``head_circuit_rejected_total``,
+  ``head_circuit_closed_total``, ``head_circuit_half_open_total``,
+  ``head_circuits_open`` (gauge), ``head_stale_fifos_cleaned_total``;
+* liveness — ``head_probes_total`` / ``head_probe_failures_total``
+  (``transport.fifo.probe``) and ``server_pings_answered_total``
+  (the ``__DOS_PING__`` control frame);
+* supervision — ``supervisor_respawns_total``,
+  ``supervisor_pings_total``, ``supervisor_ping_failures_total``,
+  ``supervisor_workers_alive`` (gauge);
+* fault harness — ``faults_injected_total`` (``DOS_FAULTS`` rules that
+  fired; in a chaos run the recovery counters above should move in
+  lock-step with it).
 """
 
 from . import metrics, trace

@@ -1,19 +1,24 @@
-"""Head↔worker data plane: wire formats, FIFO transport, job launch."""
+"""Head↔worker data plane: wire formats, FIFO transport, job launch,
+liveness probes, and head-side resilience (retry + circuit breaking)."""
 
 from .wire import (
     ENGINE_STAT_FIELDS, HEAD_STAT_FIELDS, STATS_HEADER,
-    Request, RuntimeConfig, StatsRow,
+    HealthStatus, Request, RuntimeConfig, StatsRow,
     read_query_file, write_query_file,
 )
 from .fifo import (
-    answer_fifo_path, command_fifo_path, fan_out, send, send_with_retry,
+    RetryPolicy, answer_fifo_path, clean_stale_answer_fifos,
+    command_fifo_path, fan_out, probe, send, send_with_retry,
 )
 from .launch import kill_session, launch, session_name
+from .resilience import BreakerRegistry, CircuitBreaker
 
 __all__ = [
     "ENGINE_STAT_FIELDS", "HEAD_STAT_FIELDS", "STATS_HEADER",
-    "Request", "RuntimeConfig", "StatsRow",
+    "HealthStatus", "Request", "RuntimeConfig", "StatsRow",
     "read_query_file", "write_query_file",
-    "answer_fifo_path", "command_fifo_path", "fan_out", "send",
-    "send_with_retry", "kill_session", "launch", "session_name",
+    "RetryPolicy", "answer_fifo_path", "clean_stale_answer_fifos",
+    "command_fifo_path", "fan_out", "probe", "send", "send_with_retry",
+    "kill_session", "launch", "session_name",
+    "BreakerRegistry", "CircuitBreaker",
 ]

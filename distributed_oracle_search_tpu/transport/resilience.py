@@ -1,0 +1,222 @@
+"""Head-side resilience: per-worker circuit breakers over the FIFO wire.
+
+A dead or sick worker must not keep eating a campaign's time budget one
+timeout at a time: after ``K`` consecutive batch failures the worker's
+breaker OPENs and further sends short-circuit to an instant failure row.
+An OPEN breaker half-opens two ways:
+
+* **background probes** (preferred): the registry pings the worker on the
+  cooldown cadence from a named daemon thread; the first healthy
+  :class:`~.wire.HealthStatus` moves the breaker to HALF_OPEN;
+* **cooldown fallback** (no ``probe_fn``): after ``cooldown_s`` the next
+  ``allow()`` is granted as the trial.
+
+HALF_OPEN admits exactly one trial send: success CLOSEs (consecutive
+count reset), failure re-OPENs (and restarts the probe loop).
+
+Env knobs: ``DOS_CIRCUIT_THRESHOLD`` (K, default 3),
+``DOS_CIRCUIT_COOLDOWN_S`` (default 5), ``DOS_CIRCUIT_DISABLE=1``
+(breakers always allow — the pre-PR-2 behavior).
+
+Everything takes an injectable ``clock`` so tests drive the state machine
+without sleeping; probe threads are named ``dos-probe-*`` and joined by
+:meth:`BreakerRegistry.shutdown` so the test suite's leak check can prove
+no campaign leaves one behind.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..obs import metrics as obs_metrics
+from ..utils.env import env_cast
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+M_OPENED = obs_metrics.counter(
+    "head_circuit_open_total", "breaker transitions to OPEN")
+M_REJECTED = obs_metrics.counter(
+    "head_circuit_rejected_total",
+    "batch sends short-circuited by an OPEN breaker")
+M_CLOSED = obs_metrics.counter(
+    "head_circuit_closed_total", "breakers re-CLOSED after a good trial")
+M_PROBE_HALF_OPEN = obs_metrics.counter(
+    "head_circuit_half_open_total",
+    "OPEN->HALF_OPEN transitions (probe success or cooldown lapse)")
+G_OPEN = obs_metrics.gauge(
+    "head_circuits_open", "breakers currently OPEN or HALF_OPEN")
+
+
+class CircuitBreaker:
+    """One worker's breaker (thread-safe; ``fan_out`` drives it from a
+    pool thread while the probe loop half-opens it from another)."""
+
+    def __init__(self, key, threshold: int = 3, cooldown_s: float = 5.0,
+                 clock=time.monotonic):
+        self.key = key
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        self._trial_in_flight = False
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        """May the caller send a batch to this worker right now?"""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                # cooldown fallback: without a probe loop the breaker
+                # still half-opens on its own after cooldown_s
+                if self.clock() - self.opened_at >= self.cooldown_s:
+                    self._to_half_open_locked("cooldown")
+                else:
+                    M_REJECTED.inc()
+                    return False
+            # HALF_OPEN: exactly one trial at a time
+            if self._trial_in_flight:
+                M_REJECTED.inc()
+                return False
+            self._trial_in_flight = True
+            return True
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            trial = self._trial_in_flight
+            self._trial_in_flight = False
+            if ok:
+                self.consecutive_failures = 0
+                if self.state != CLOSED:
+                    log.info("circuit for %s CLOSED (good %s)", self.key,
+                             "trial" if trial else "send")
+                    self.state = CLOSED
+                    M_CLOSED.inc()
+                    G_OPEN.add(-1)
+                return
+            self.consecutive_failures += 1
+            if self.state == HALF_OPEN:
+                log.warning("circuit for %s trial failed; re-OPEN",
+                            self.key)
+                self.state = OPEN
+                self.opened_at = self.clock()
+                M_OPENED.inc()
+            elif (self.state == CLOSED
+                  and self.consecutive_failures >= self.threshold):
+                log.error("circuit for %s OPEN after %d consecutive "
+                          "failures", self.key, self.consecutive_failures)
+                self.state = OPEN
+                self.opened_at = self.clock()
+                M_OPENED.inc()
+                G_OPEN.add(1)
+
+    def half_open(self, why: str = "probe") -> None:
+        with self._lock:
+            if self.state == OPEN:
+                self._to_half_open_locked(why)
+
+    def _to_half_open_locked(self, why: str) -> None:
+        log.info("circuit for %s HALF_OPEN (%s)", self.key, why)
+        self.state = HALF_OPEN
+        self._trial_in_flight = False
+        M_PROBE_HALF_OPEN.inc()
+
+
+class BreakerRegistry:
+    """Per-worker breakers keyed by ``(host, wid)`` + the probe loops.
+
+    ``probe_fn(key) -> HealthStatus | None`` is supplied by the campaign
+    driver (it knows the nfs dir and FIFO layout); when present, every
+    OPEN transition starts one short-lived ``dos-probe-*`` daemon thread
+    that pings on the cooldown cadence until the worker answers healthy
+    (→ HALF_OPEN) or the registry shuts down.
+    """
+
+    def __init__(self, threshold: int | None = None,
+                 cooldown_s: float | None = None,
+                 probe_fn=None, enabled: bool | None = None,
+                 clock=time.monotonic):
+        self.threshold = (threshold if threshold is not None
+                          else env_cast("DOS_CIRCUIT_THRESHOLD", 3, int))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else env_cast("DOS_CIRCUIT_COOLDOWN_S", 5.0,
+                                         float))
+        self.enabled = (enabled if enabled is not None
+                        else os.environ.get("DOS_CIRCUIT_DISABLE", "")
+                        != "1")
+        self.probe_fn = probe_fn
+        self.clock = clock
+        self._breakers: dict = {}
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    def get(self, key) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None:
+                br = CircuitBreaker(key, threshold=self.threshold,
+                                    cooldown_s=self.cooldown_s,
+                                    clock=self.clock)
+                self._breakers[key] = br
+            return br
+
+    def allow(self, key) -> bool:
+        return self.get(key).allow() if self.enabled else True
+
+    def record(self, key, ok: bool) -> None:
+        if not self.enabled:
+            return
+        br = self.get(key)
+        was_open = br.state
+        br.record(ok)
+        if br.state == OPEN and was_open != OPEN:
+            self._start_probe(br)
+
+    # ------------------------------------------------------ probe loops
+    def _start_probe(self, br: CircuitBreaker) -> None:
+        if self.probe_fn is None or self._stop.is_set():
+            return
+
+        def loop():
+            while not self._stop.wait(self.cooldown_s):
+                if br.state != OPEN:
+                    return
+                try:
+                    st = self.probe_fn(br.key)
+                except Exception as e:  # noqa: BLE001 — a probe bug
+                    # must not kill the loop that heals the breaker
+                    log.warning("probe of %s raised: %s", br.key, e)
+                    st = None
+                if st is not None and getattr(st, "ok", False):
+                    br.half_open("probe")
+                    return
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"dos-probe-{br.key}")
+        with self._lock:
+            self._threads.append(t)
+        t.start()
+
+    def shutdown(self, join_s: float = 5.0) -> None:
+        """Stop probe loops and join their threads (campaign end)."""
+        self._stop.set()
+        with self._lock:
+            threads, self._threads = self._threads, []
+        for t in threads:
+            t.join(timeout=join_s)
+
+    def snapshot(self) -> dict:
+        """State of every breaker (for ``degraded.json`` and logs)."""
+        with self._lock:
+            return {repr(k): {"state": b.state,
+                              "consecutive_failures":
+                                  b.consecutive_failures}
+                    for k, b in self._breakers.items()}

@@ -39,6 +39,12 @@ HEAD_STAT_FIELDS = ("t_prepare", "t_partition", "size")
 #: 10-field CSV line and can never equal this)
 FAIL_LINE = "FAIL"
 
+#: liveness control frame: ``__DOS_PING__ <answerfifo>`` as a single
+#: command-FIFO line asks the server to write one health JSON line
+#: (:class:`HealthStatus`) to the named FIFO — the wire half of
+#: ``transport.fifo.probe`` and the supervisor's monitoring loop
+PING_TOKEN = "__DOS_PING__"
+
 #: full per-row CSV header (reference ``process_query.py:198-213`` plus the
 #: leading experiment index the print path shows)
 STATS_HEADER = ["expe", *ENGINE_STAT_FIELDS, *HEAD_STAT_FIELDS]
@@ -163,6 +169,35 @@ class StatsRow:
         """Full head-side row (engine fields + appended head fields)."""
         return ([getattr(self, f) for f in ENGINE_STAT_FIELDS]
                 + [t_prepare, t_partition, size])
+
+
+@dataclasses.dataclass
+class HealthStatus:
+    """One server's answer to a ``__DOS_PING__`` control frame.
+
+    Same compat contract as :class:`RuntimeConfig`: ``from_json`` filters
+    unknown keys symmetrically, so old heads can probe new servers and
+    vice versa. ``dropped``/``batch_failures`` mirror the server's obs
+    counters so a head-side probe can read a remote worker's failure
+    counters without a metrics endpoint."""
+
+    ok: bool = True
+    wid: int = -1
+    pid: int = 0
+    uptime_s: float = 0.0
+    batches: int = 0            # requests answered since start
+    batch_failures: int = 0     # batches answered with FAIL
+    dropped: int = 0            # replies dropped (no reader)
+    last_error: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, line: str) -> "HealthStatus":
+        d = json.loads(line)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
 
 # ------------------------------------------------------------ paths files

@@ -1,0 +1,28 @@
+"""Env-var parsing for the runtime knobs (``DOS_*``).
+
+One helper, one policy: a missing or malformed value falls back to the
+default with a log line — a typo in an ops environment must degrade the
+knob, never crash a campaign or silently change semantics per call site.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .log import get_logger
+
+log = get_logger(__name__)
+
+
+def env_cast(name: str, default, cast):
+    """``cast(os.environ[name])`` with ``default`` on absence or a value
+    ``cast`` rejects (logged)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except (TypeError, ValueError):
+        log.warning("ignoring malformed %s=%r (using %r)", name, raw,
+                    default)
+        return default

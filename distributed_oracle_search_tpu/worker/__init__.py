@@ -1,6 +1,9 @@
-"""Worker-resident components: per-shard engine + FIFO server."""
+"""Worker-resident components: per-shard engine + FIFO server +
+supervisor."""
 
 from .engine import ShardEngine, load_shard_rows
 from .server import FifoServer, stop_server
+from .supervisor import WorkerSupervisor
 
-__all__ = ["ShardEngine", "load_shard_rows", "FifoServer", "stop_server"]
+__all__ = ["ShardEngine", "load_shard_rows", "FifoServer", "stop_server",
+           "WorkerSupervisor"]

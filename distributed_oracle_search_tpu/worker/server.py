@@ -28,8 +28,10 @@ from ..data.graph import Graph
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
+from ..testing import faults
 from ..transport.wire import (
-    Request, StatsRow, paths_file_for, read_query_file, write_paths_file,
+    HealthStatus, PING_TOKEN, Request, StatsRow, paths_file_for,
+    read_query_file, write_paths_file,
 )
 from ..transport.fifo import command_fifo_path
 from ..utils.config import ClusterConfig
@@ -61,6 +63,13 @@ M_DROPPED = obs_metrics.counter(
 M_REPLY_WAIT = obs_metrics.histogram(
     "server_reply_open_wait_seconds",
     "time a reply waited for the head to open its answer-FIFO reader")
+M_PINGS = obs_metrics.counter(
+    "server_pings_answered_total",
+    "__DOS_PING__ control frames answered with a health line")
+M_PING_DROPS = obs_metrics.counter(
+    "server_ping_replies_dropped_total",
+    "health replies dropped (prober gone) — kept separate from "
+    "server_replies_dropped_total so data-plane drop alerts stay clean")
 
 
 class FifoServer:
@@ -130,9 +139,18 @@ class FifoServer:
         under ``PIPE_BUF`` (4 KiB on Linux, far above any real request)
         is written atomically, so frames can never interleave.
         """
+        import time as _time
+
         self._ensure_fifo()
         set_worker_id(self.wid)      # tag this serve thread's log records
         log.info("worker %d serving on %s", self.wid, self.command_fifo)
+        # liveness state answered to __DOS_PING__ control frames (set
+        # here, not __init__: bare test servers skip __init__, and the
+        # uptime clock should start when serving does)
+        self._t_start = _time.monotonic()
+        self._batches = 0
+        self._batch_failures = 0
+        self._last_error = ""
         fd = os.open(self.command_fifo, os.O_RDWR)
         self._rdbuf = b""
         try:
@@ -142,6 +160,11 @@ class FifoServer:
                     log.info("worker %d: stop requested", self.wid)
                     return
                 if not line1.strip():
+                    continue
+                if line1.lstrip().startswith(PING_TOKEN):
+                    # single-line control frame: never counts as a data
+                    # frame, never touches the engine
+                    self._answer_ping(line1)
                     continue
                 M_FRAMES.inc()
                 if not line1.lstrip().startswith("{"):
@@ -184,13 +207,39 @@ class FifoServer:
                     M_MALFORMED.inc()
                     self._answer_malformed(text)
                     continue
+                kill = faults.inject("kill-mid-batch", wid=self.wid)
+                if kill is not None:
+                    # the injected analog of a worker crash between
+                    # reading a request and answering it — the exact
+                    # failure that wedges the reference head forever
+                    log.error("fault: worker %d dying mid-batch",
+                              self.wid)
+                    if kill.mode == "exit":
+                        os._exit(faults.KILL_EXIT_CODE)
+                    return  # mode=raise: in-thread server dies quietly
                 try:
+                    if faults.inject("crash-engine",
+                                     wid=self.wid) is not None:
+                        raise RuntimeError("injected fault: crash-engine")
                     stats = self.handle(req)
+                    self._batches += 1
                 except Exception as e:  # noqa: BLE001 — never leave
                     # the head blocked on `cat answer`; send a failure
                     log.exception("batch failed: %s", e)
                     M_BATCH_FAIL.inc()
+                    self._batches += 1
+                    self._batch_failures += 1
+                    self._last_error = f"{type(e).__name__}: {e}"
                     stats = StatsRow.failed()
+                delay = faults.inject("delay", wid=self.wid)
+                if delay is not None:
+                    log.warning("fault: delaying reply %.2fs", delay.delay)
+                    _time.sleep(delay.delay)
+                if faults.inject("drop-reply", wid=self.wid) is not None:
+                    log.error("fault: dropping reply to %s",
+                              req.answerfifo)
+                    M_DROPPED.inc()
+                    continue
                 self._reply(req.answerfifo, stats.encode_wire() + "\n")
         finally:
             os.close(fd)
@@ -245,15 +294,19 @@ class FifoServer:
         return v if v > 0 else 30.0
 
     def _reply(self, answerfifo: str, line: str,
-               deadline_s: float | None = None) -> None:
+               deadline_s: float | None = None,
+               drop_counter=None) -> None:
         """Write the stats line without ever wedging the server: a
         blocking ``open(fifo, 'w')`` would hang forever if the head's
         ``cat <answer>`` was killed before opening its end. Non-blocking
         open with a bounded deadline (``deadline_s`` overrides the
-        configured one); drop the reply (logged) if no reader appears."""
+        configured one); drop the reply (logged) if no reader appears.
+        ``drop_counter`` overrides which counter books the drop (control
+        frames must not pollute the data-plane drop alert)."""
         import errno
         import time as _time
 
+        dropped = drop_counter if drop_counter is not None else M_DROPPED
         wait_s = (deadline_s if deadline_s is not None
                   else self.reply_deadline_s)
         t_wait0 = _time.monotonic()
@@ -265,12 +318,12 @@ class FifoServer:
             except OSError as e:
                 if e.errno not in (errno.ENXIO, errno.ENOENT):
                     log.error("cannot open %s: %s", answerfifo, e)
-                    M_DROPPED.inc()
+                    dropped.inc()
                     return
                 if _time.monotonic() > deadline:
                     log.error("no reader on %s within %.0fs; dropping "
                               "reply", answerfifo, wait_s)
-                    M_DROPPED.inc()
+                    dropped.inc()
                     return
                 _time.sleep(0.05)
         M_REPLY_WAIT.observe(_time.monotonic() - t_wait0)
@@ -285,7 +338,7 @@ class FifoServer:
             # reader vanished between open and write (BrokenPipe):
             # drop the reply, never crash the serve loop
             log.error("reply to %s failed: %s", answerfifo, e)
-            M_DROPPED.inc()
+            dropped.inc()
         finally:
             os.close(fd)
 
@@ -314,15 +367,78 @@ class FifoServer:
                 except OSError:
                     continue
 
+    #: reader-wait for ping replies: the prober is already blocked on its
+    #: answer FIFO when the ping lands, so a long wait only ever means
+    #: the prober died — don't stall the serve loop for it
+    PING_REPLY_DEADLINE_S = 5.0
+
+    def _answer_ping(self, line: str) -> None:
+        """Answer a ``__DOS_PING__ <answerfifo>`` control frame with one
+        health JSON line (:class:`~..transport.wire.HealthStatus`)."""
+        import time as _time
+
+        toks = line.split()
+        if len(toks) < 2:
+            log.error("ping frame names no answer FIFO: %r", line)
+            return
+        status = HealthStatus(
+            ok=True, wid=self.wid, pid=os.getpid(),
+            uptime_s=_time.monotonic() - getattr(self, "_t_start", 0.0),
+            batches=getattr(self, "_batches", 0),
+            batch_failures=getattr(self, "_batch_failures", 0),
+            dropped=int(M_DROPPED.value),
+            last_error=getattr(self, "_last_error", ""),
+        )
+        self._reply(toks[1], status.to_json() + "\n",
+                    deadline_s=self.PING_REPLY_DEADLINE_S,
+                    drop_counter=M_PING_DROPS)
+        M_PINGS.inc()
+
     def stop_file(self) -> None:
         """Write the stop token into our own FIFO (for another process)."""
-        with open(self.command_fifo, "w") as f:
-            f.write(STOP_TOKEN + "\n")
+        stop_server(self.command_fifo)
 
 
-def stop_server(command_fifo: str) -> None:
-    with open(command_fifo, "w") as f:
-        f.write(STOP_TOKEN + "\n")
+def stop_server(command_fifo: str, deadline_s: float = 2.0) -> bool:
+    """Push the stop token; never wedge the caller.
+
+    A blocking ``open(fifo, "w")`` hangs forever when the server is
+    already dead (a hard crash leaves the FIFO behind with no reader), so
+    open non-blocking and give up — logged, not raised — after
+    ``deadline_s``. Returns True iff the token was delivered. A live
+    server always has a reader (its own ``O_RDWR`` open), so the fast
+    path succeeds on the first try.
+    """
+    import errno
+    import time as _time
+
+    deadline = _time.monotonic() + deadline_s
+    fd = -1
+    while fd < 0:
+        try:
+            fd = os.open(command_fifo, os.O_WRONLY | os.O_NONBLOCK)
+        except OSError as e:
+            if e.errno == errno.ENOENT:
+                log.info("no FIFO at %s; server already gone",
+                         command_fifo)
+                return False
+            if e.errno != errno.ENXIO:
+                log.error("cannot open %s to stop server: %s",
+                          command_fifo, e)
+                return False
+            if _time.monotonic() > deadline:
+                log.warning("no server reading %s within %.1fs; "
+                            "skipping stop", command_fifo, deadline_s)
+                return False
+            _time.sleep(0.05)
+    try:
+        os.write(fd, (STOP_TOKEN + "\n").encode())
+        return True
+    except OSError as e:
+        log.warning("stop token to %s failed: %s", command_fifo, e)
+        return False
+    finally:
+        os.close(fd)
 
 
 def main(argv=None) -> int:

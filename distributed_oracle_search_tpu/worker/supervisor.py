@@ -1,0 +1,316 @@
+"""Worker supervisor: launch resident servers, monitor, respawn.
+
+The reference leaves crashed servers dead until a human re-runs
+``make_fifos`` (its tmux sessions are forensics, not recovery). The
+supervisor closes that loop for local workers:
+
+* **launch** — one ``worker.server`` subprocess per worker id (its own
+  process group, stdout/stderr to a per-worker logfile), readiness
+  confirmed by a liveness probe, not FIFO existence (a hard crash leaves
+  a stale FIFO behind that would fool an existence check);
+* **monitor** — a named ``dos-supervisor`` daemon thread polls each
+  subprocess and pings it through the command FIFO
+  (``transport.fifo.probe``) every ``ping_interval_s``;
+* **respawn** — a dead process is relaunched with capped exponential
+  backoff (``base * 2^k`` up to ``cap``); the backoff step resets once
+  the respawned worker answers a ping. Hung-worker recovery (process
+  alive, pings failing) is opt-in via ``unhealthy_pings`` because a
+  single-threaded server legitimately goes quiet for the length of a
+  batch (cold XLA compiles run minutes) — enable it only with a ping
+  interval comfortably above your worst batch.
+
+Env knobs: ``DOS_SUPERVISOR_PING_S`` (default 2), ``DOS_SUPERVISOR_BACKOFF_BASE_S``
+(default 0.5), ``DOS_SUPERVISOR_BACKOFF_CAP_S`` (default 30),
+``DOS_SUPERVISOR_UNHEALTHY_PINGS`` (default 0 = ping-based respawn off).
+
+Remote hosts keep the reference's ssh+tmux launch path
+(``cli.make_fifos``); supervision there means running this module on the
+worker host itself (``python -m ...cli.make_fifos --supervise`` with a
+conf whose workers are local).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from ..obs import metrics as obs_metrics
+from ..transport import fifo as fifo_transport
+from ..utils.config import ClusterConfig
+from ..utils.env import env_cast
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+M_RESPAWNS = obs_metrics.counter(
+    "supervisor_respawns_total", "worker subprocesses relaunched")
+M_SUP_PINGS = obs_metrics.counter(
+    "supervisor_pings_total", "liveness pings sent by the supervisor")
+M_SUP_PING_FAIL = obs_metrics.counter(
+    "supervisor_ping_failures_total",
+    "supervisor pings that got no healthy reply")
+G_ALIVE = obs_metrics.gauge(
+    "supervisor_workers_alive", "supervised worker processes running")
+
+
+class SupervisedWorker:
+    """Book-keeping for one supervised worker process."""
+
+    __slots__ = ("wid", "fifo", "proc", "respawns", "backoff_k",
+                 "next_spawn_at", "ping_failures", "healthy_once")
+
+    def __init__(self, wid: int, fifo: str):
+        self.wid = wid
+        self.fifo = fifo
+        self.proc: subprocess.Popen | None = None
+        self.respawns = 0
+        self.backoff_k = 0
+        self.next_spawn_at = 0.0
+        self.ping_failures = 0
+        self.healthy_once = False
+
+
+class WorkerSupervisor:
+    """Launch + monitor + respawn local resident query servers.
+
+    ``spawn_fn(worker) -> subprocess.Popen`` and
+    ``probe_fn(worker) -> HealthStatus | None`` are injectable so tests
+    can supervise cheap dummy processes; the defaults launch the real
+    ``worker.server`` module and ping it over its command FIFO.
+    """
+
+    def __init__(self, conf: ClusterConfig, conf_path: str | None = None,
+                 wids=None, alg: str = "table-search",
+                 fifo_dir: str | None = None,
+                 logdir: str | None = None,
+                 ping_interval_s: float | None = None,
+                 backoff_base_s: float | None = None,
+                 backoff_cap_s: float | None = None,
+                 unhealthy_pings: int | None = None,
+                 probe_timeout_s: float = 10.0,
+                 spawn_fn=None, probe_fn=None):
+        self.conf = conf
+        self.conf_path = conf_path
+        self.alg = alg
+        self.fifo_dir = fifo_dir
+        self.logdir = logdir
+        self.ping_interval_s = (
+            ping_interval_s if ping_interval_s is not None
+            else env_cast("DOS_SUPERVISOR_PING_S", 2.0, float))
+        self.backoff_base_s = (
+            backoff_base_s if backoff_base_s is not None
+            else env_cast("DOS_SUPERVISOR_BACKOFF_BASE_S", 0.5, float))
+        self.backoff_cap_s = (
+            backoff_cap_s if backoff_cap_s is not None
+            else env_cast("DOS_SUPERVISOR_BACKOFF_CAP_S", 30.0, float))
+        self.unhealthy_pings = (
+            unhealthy_pings if unhealthy_pings is not None
+            else env_cast("DOS_SUPERVISOR_UNHEALTHY_PINGS", 0, int))
+        self.probe_timeout_s = probe_timeout_s
+        self.spawn_fn = spawn_fn or self._spawn_server
+        self.probe_fn = probe_fn or self._probe_server
+        wids = list(wids) if wids is not None else list(
+            range(conf.maxworker))
+        self.workers = {wid: SupervisedWorker(wid, self._fifo_for(wid))
+                        for wid in wids}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # --------------------------------------------------------- defaults
+    def _fifo_for(self, wid: int) -> str:
+        if self.fifo_dir:
+            return os.path.join(self.fifo_dir, f"worker{wid}.fifo")
+        return fifo_transport.command_fifo_path(wid)
+
+    def _spawn_server(self, w: SupervisedWorker) -> subprocess.Popen:
+        if not self.conf_path:
+            raise ValueError("supervising real servers needs conf_path")
+        cmd = [sys.executable, "-m",
+               "distributed_oracle_search_tpu.worker.server",
+               "-c", self.conf_path, "--workerid", str(w.wid),
+               "--fifo", w.fifo, "--alg", self.alg]
+        out = subprocess.DEVNULL
+        if self.logdir:
+            os.makedirs(self.logdir, exist_ok=True)
+            out = open(os.path.join(self.logdir, f"worker{w.wid}.log"),
+                       "ab")
+        return subprocess.Popen(cmd, cwd=self.conf.projectdir,
+                                stdout=out, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+
+    def _probe_server(self, w: SupervisedWorker):
+        return fifo_transport.probe(
+            "localhost", w.wid, command_fifo=w.fifo, nfs=self.conf.nfs,
+            timeout=self.probe_timeout_s)
+
+    # ---------------------------------------------------------- control
+    def start(self, wait_ready_s: float = 120.0) -> None:
+        """Spawn every worker, wait until each answers a ping, then
+        start the monitor thread. A startup failure stops the workers
+        already spawned before re-raising — they were launched in their
+        own sessions and would otherwise outlive the failed supervisor,
+        squatting on the command FIFOs of the operator's retry run."""
+        try:
+            self._start_inner(wait_ready_s)
+        except BaseException:
+            self.stop(join_s=5.0)
+            raise
+
+    def _start_inner(self, wait_ready_s: float) -> None:
+        for w in self.workers.values():
+            w.proc = self.spawn_fn(w)
+            log.info("supervisor: spawned worker %d (pid %d)", w.wid,
+                     w.proc.pid)
+        deadline = time.monotonic() + wait_ready_s
+        pending = set(self.workers)
+        while pending and time.monotonic() < deadline:
+            for wid in sorted(pending):
+                w = self.workers[wid]
+                if w.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"worker {wid} died during startup "
+                        f"(rc={w.proc.returncode})")
+                st = self.probe_fn(w)
+                if st is not None and getattr(st, "ok", False):
+                    w.healthy_once = True
+                    pending.discard(wid)
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            raise RuntimeError(
+                f"workers {sorted(pending)} not ready within "
+                f"{wait_ready_s:.0f}s")
+        G_ALIVE.set(len(self.workers))
+        self._thread = threading.Thread(target=self._monitor,
+                                        daemon=True,
+                                        name="dos-supervisor")
+        self._thread.start()
+        log.info("supervisor: %d worker(s) ready", len(self.workers))
+
+    def stop(self, join_s: float = 10.0) -> None:
+        """Stop monitoring, then stop the servers (graceful token first,
+        SIGTERM/SIGKILL escalation after)."""
+        from .server import stop_server
+
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_s)
+            self._thread = None
+        for w in self.workers.values():
+            if w.proc is None or w.proc.poll() is not None:
+                continue
+            stop_server(w.fifo, deadline_s=1.0)
+        for w in self.workers.values():
+            if w.proc is None:
+                continue
+            try:
+                w.proc.wait(timeout=join_s)
+            except subprocess.TimeoutExpired:
+                w.proc.terminate()
+                try:
+                    w.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+                    w.proc.wait(timeout=5.0)
+        G_ALIVE.set(0)
+
+    def __enter__(self) -> "WorkerSupervisor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --------------------------------------------------------- monitor
+    def _backoff_s(self, w: SupervisedWorker) -> float:
+        return min(self.backoff_cap_s,
+                   self.backoff_base_s * (2 ** w.backoff_k))
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.ping_interval_s):
+            alive = 0
+            for w in self.workers.values():
+                if self._stop.is_set():
+                    return
+                try:
+                    alive += self._monitor_one(w)
+                except Exception:  # noqa: BLE001 — a spawn/probe bug
+                    # must not kill the only thread doing recovery; the
+                    # next tick retries (respawns under backoff)
+                    log.exception("supervisor: monitoring worker %d "
+                                  "failed; will retry", w.wid)
+            G_ALIVE.set(alive)
+
+    def _monitor_one(self, w: SupervisedWorker) -> int:
+        """Returns 1 if the worker process is running, else 0."""
+        if w.proc is None or w.proc.poll() is not None:
+            self._maybe_respawn(w, "process died")
+            return 0
+        M_SUP_PINGS.inc()
+        st = self.probe_fn(w)
+        healthy = st is not None and getattr(st, "ok", False)
+        if healthy:
+            w.ping_failures = 0
+            if not w.healthy_once:
+                w.healthy_once = True
+                w.backoff_k = 0   # respawn confirmed good
+            return 1
+        M_SUP_PING_FAIL.inc()
+        w.ping_failures += 1
+        if (self.unhealthy_pings
+                and w.ping_failures >= self.unhealthy_pings):
+            log.error("supervisor: worker %d unresponsive after "
+                      "%d pings; killing for respawn", w.wid,
+                      w.ping_failures)
+            w.proc.kill()
+            try:
+                w.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+            self._maybe_respawn(w, "hung (ping failures)")
+            return 0
+        return 1
+
+    def _maybe_respawn(self, w: SupervisedWorker, why: str) -> None:
+        now = time.monotonic()
+        if w.next_spawn_at == 0.0:
+            # first observation of this death: schedule the respawn
+            delay = self._backoff_s(w)
+            w.next_spawn_at = now + delay
+            rc = w.proc.returncode if w.proc is not None else None
+            log.error("supervisor: worker %d down (%s, rc=%s); respawn "
+                      "in %.2fs (backoff step %d)", w.wid, why, rc,
+                      delay, w.backoff_k)
+            return
+        if now < w.next_spawn_at:
+            return
+        w.next_spawn_at = 0.0
+        w.backoff_k += 1
+        w.ping_failures = 0
+        w.healthy_once = False      # reset backoff only after a good ping
+        w.proc = self.spawn_fn(w)
+        w.respawns += 1
+        M_RESPAWNS.inc()
+        log.warning("supervisor: respawned worker %d (pid %d, "
+                    "respawn #%d)", w.wid, w.proc.pid, w.respawns)
+
+
+def supervise_forever(conf: ClusterConfig, conf_path: str,
+                      alg: str = "table-search",
+                      logdir: str | None = None) -> int:
+    """``make_fifos --supervise`` entry: run until interrupted."""
+    sup = WorkerSupervisor(conf, conf_path, alg=alg, logdir=logdir)
+    sup.start()
+    print(f"supervising {len(sup.workers)} worker(s); Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        log.info("supervisor: interrupted; stopping workers")
+    finally:
+        sup.stop()
+    return 0

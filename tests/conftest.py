@@ -30,10 +30,17 @@ except AttributeError:
     pass
 assert len(jax.devices()) == 8, jax.devices()
 
+import glob
+import stat
+import threading
+import time
+
 import numpy as np
 import pytest
 
 from distributed_oracle_search_tpu.data import synth_city_graph, synth_scenario
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.testing import faults
 
 
 @pytest.fixture(scope="session")
@@ -45,3 +52,49 @@ def toy_graph():
 @pytest.fixture(scope="session")
 def toy_queries(toy_graph):
     return synth_scenario(toy_graph.n, 64, seed=11)
+
+
+def _shared_dir_fifos() -> set:
+    """FIFOs in /tmp matching the transport's naming conventions — the
+    default shared dir, where a leak would poison later runs."""
+    out = set()
+    for pat in ("/tmp/worker*.fifo", "/tmp/answer.*"):
+        for p in glob.glob(pat):
+            try:
+                if stat.S_ISFIFO(os.stat(p).st_mode):
+                    out.add(p)
+            except OSError:
+                continue
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_tolerance_resources():
+    """Every test must clean up after the fault-tolerance layer: no
+    ``dos-*`` supervisor/probe thread still alive, the supervisor gauge
+    back at zero (checked via a metrics snapshot), no new FIFO left in
+    the shared /tmp dir, and no armed fault injector bleeding into the
+    next test."""
+    fifos_before = _shared_dir_fifos()
+    threads_before = {t.name for t in threading.enumerate()
+                      if t.name.startswith("dos-")}
+    yield
+    faults.reset()
+    # daemon probe threads notice shutdown on their next wait tick —
+    # allow a short grace before calling a thread leaked
+    deadline = time.monotonic() + 3.0
+    leaked = []
+    while time.monotonic() < deadline:
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("dos-") and t.is_alive()
+                  and t.name not in threads_before]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"leaked supervisor/probe threads: {leaked}"
+    snap = obs_metrics.REGISTRY.snapshot()
+    alive = snap["gauges"].get("supervisor_workers_alive", 0)
+    assert alive == 0, f"supervisor gauge reports {alive} workers alive"
+    fifos_after = _shared_dir_fifos()
+    assert fifos_after <= fifos_before, (
+        f"leaked FIFOs in /tmp: {sorted(fifos_after - fifos_before)}")

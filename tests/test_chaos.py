@@ -1,0 +1,293 @@
+"""Fault-tolerant campaign execution, end to end.
+
+Non-slow: real host-mode campaigns through in-thread ``FifoServer``
+instances with injected engine crashes — graceful degradation
+(``degraded.json``, distinct exit codes) and circuit breaking, asserted
+through the obs counters.
+
+Slow: the full chaos drill — 3 supervised worker SUBPROCESSES, one
+killed mid-round by the fault harness (twice: once per send attempt, the
+budget shared across respawns via ``DOS_FAULTS_STATE``), one dropping a
+reply that the head's retry recovers. The campaign must complete
+degraded, the supervisor must respawn the dead worker within its backoff
+cap, and every recovery path must show in its counter.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from distributed_oracle_search_tpu.cli import process_query as pq
+from distributed_oracle_search_tpu.data import (
+    Graph, ensure_synth_dataset,
+)
+from distributed_oracle_search_tpu.models.cpd import write_index_manifest
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.testing import faults
+from distributed_oracle_search_tpu.transport import fifo as fifo_mod
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker import FifoServer, stop_server
+from distributed_oracle_search_tpu.worker import server as server_mod
+from distributed_oracle_search_tpu.worker import supervisor as sup_mod
+from distributed_oracle_search_tpu.worker.build import main as build_main
+from distributed_oracle_search_tpu.worker.supervisor import (
+    WorkerSupervisor,
+)
+
+N_WORKERS = 3
+
+
+@pytest.fixture(scope="module")
+def chaos_cluster(tmp_path_factory):
+    """Tiny dataset + built 3-worker index; tests derive their own conf
+    files (round counts differ)."""
+    datadir = str(tmp_path_factory.mktemp("chaosdata"))
+    paths = ensure_synth_dataset(datadir, width=8, height=6,
+                                 n_queries=45, seed=23)
+    outdir = os.path.join(datadir, "index")
+    for wid in range(N_WORKERS):
+        build_main(["--input", paths["xy"], "--partmethod", "mod",
+                    "--partkey", str(N_WORKERS), "--workerid", str(wid),
+                    "--maxworker", str(N_WORKERS), "--outdir", outdir])
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", N_WORKERS, N_WORKERS, g.n)
+    write_index_manifest(outdir, dc)
+    return datadir, paths, outdir
+
+
+def _conf(chaos_cluster, name, diffs):
+    datadir, paths, outdir = chaos_cluster
+    conf = ClusterConfig(
+        workers=["localhost"] * N_WORKERS,
+        partmethod="mod", partkey=N_WORKERS,
+        outdir=outdir, xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=diffs, nfs=datadir,
+    ).validate()
+    path = os.path.join(datadir, name)
+    conf.save(path)
+    return conf, path
+
+
+def _thread_servers(conf, tmp_path, monkeypatch):
+    fifos = {wid: str(tmp_path / f"worker{wid}.fifo")
+             for wid in range(conf.maxworker)}
+    monkeypatch.setattr(pq, "command_fifo_path", lambda wid: fifos[wid])
+    servers = [FifoServer(conf, wid, command_fifo=fifos[wid])
+               for wid in range(conf.maxworker)]
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in servers]
+    for t in threads:
+        t.start()
+    for fifo in fifos.values():
+        for _ in range(100):
+            if os.path.exists(fifo):
+                break
+            time.sleep(0.02)
+    return fifos, threads
+
+
+def _stop_all(fifos, threads):
+    for fifo in fifos.values():
+        stop_server(fifo, deadline_s=5.0)
+    for t in threads:
+        t.join(timeout=15)
+
+
+def _counter(name):
+    return obs_metrics.REGISTRY.snapshot()["counters"].get(name, 0)
+
+
+def test_degraded_campaign_exit_code_and_manifest(
+        chaos_cluster, tmp_path, monkeypatch):
+    """One worker's engine crashes on every batch: the campaign finishes
+    with partial results, exit code EXIT_DEGRADED, a degraded.json
+    naming the worker, and — once its failures pass the circuit
+    threshold — short-circuited batches instead of futile sends."""
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "crash-engine;wid=1;times=inf")
+    monkeypatch.setenv("DOS_RETRY_MAX", "1")
+    monkeypatch.setenv("DOS_RETRY_BASE_S", "0.05")
+    monkeypatch.setenv("DOS_RETRY_JITTER", "0")
+    monkeypatch.setenv("DOS_CIRCUIT_THRESHOLD", "2")
+    monkeypatch.setenv("DOS_CIRCUIT_COOLDOWN_S", "300")
+    conf, conf_path = _conf(chaos_cluster, "conf-degraded.json",
+                            diffs=["-", "-", "-", "-"])
+    fifos, threads = _thread_servers(conf, tmp_path, monkeypatch)
+    outdir = str(tmp_path / "artifacts")
+    retries0 = _counter("head_retries_total")
+    opened0 = _counter("head_circuit_open_total")
+    rejected0 = _counter("head_circuit_rejected_total")
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host",
+                      "-o", outdir])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_DEGRADED
+    man = json.load(open(os.path.join(outdir, "degraded.json")))
+    assert man["exit_code"] == pq.EXIT_DEGRADED
+    assert man["failed_workers"] == [1]
+    assert man["total_batches"] == 4 * N_WORKERS
+    assert man["failed_count"] == 4
+    reasons = [f["reason"] for f in man["failed_batches"]]
+    # rounds 0-1 fail on the wire (retried), 2-3 are short-circuited by
+    # the breaker that OPENed after 2 consecutive failures
+    assert reasons == ["send-failed", "send-failed",
+                       "circuit-open", "circuit-open"]
+    assert _counter("head_retries_total") - retries0 == 2
+    assert _counter("head_circuit_open_total") - opened0 == 1
+    assert _counter("head_circuit_rejected_total") - rejected0 == 2
+    # partial results made it out: parts.csv holds every batch row
+    assert os.path.exists(os.path.join(outdir, "parts.csv"))
+    assert os.path.exists(os.path.join(outdir, "obs_metrics.json"))
+
+
+def test_all_failed_campaign_exit_code(chaos_cluster, tmp_path,
+                                       monkeypatch):
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "crash-engine;times=inf")
+    monkeypatch.setenv("DOS_RETRY_MAX", "0")
+    conf, conf_path = _conf(chaos_cluster, "conf-allfail.json",
+                            diffs=["-"])
+    fifos, threads = _thread_servers(conf, tmp_path, monkeypatch)
+    outdir = str(tmp_path / "artifacts-allfail")
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host",
+                      "-o", outdir])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_FAILED
+    man = json.load(open(os.path.join(outdir, "degraded.json")))
+    assert man["failed_workers"] == list(range(N_WORKERS))
+    assert man["failed_count"] == N_WORKERS
+
+
+def test_clean_campaign_exit_code_and_no_manifest(chaos_cluster,
+                                                  tmp_path, monkeypatch):
+    faults.reset()
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+    conf, conf_path = _conf(chaos_cluster, "conf-clean.json",
+                            diffs=["-"])
+    fifos, threads = _thread_servers(conf, tmp_path, monkeypatch)
+    outdir = str(tmp_path / "artifacts-clean")
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host",
+                      "-o", outdir])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN
+    assert not os.path.exists(os.path.join(outdir, "degraded.json"))
+
+
+def test_campaign_sweeps_stale_answer_fifos(chaos_cluster, tmp_path,
+                                            monkeypatch):
+    """Satellite: FIFOs orphaned by a crashed earlier run are removed at
+    campaign start, counted on head_stale_fifos_cleaned_total."""
+    faults.reset()
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+    datadir = chaos_cluster[0]
+    stale = [os.path.join(datadir, "answer.localhost9.a0"),
+             os.path.join(datadir, "answer.deadhost0")]
+    for p in stale:
+        os.mkfifo(p)
+    before = _counter("head_stale_fifos_cleaned_total")
+    conf, conf_path = _conf(chaos_cluster, "conf-sweep.json",
+                            diffs=["-"])
+    fifos, threads = _thread_servers(conf, tmp_path, monkeypatch)
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host"])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN
+    assert not any(os.path.exists(p) for p in stale)
+    assert _counter("head_stale_fifos_cleaned_total") - before >= 2
+
+
+# ---------------------------------------------------------- the chaos drill
+
+@pytest.mark.slow
+def test_chaos_kill_worker_mid_round_supervised(chaos_cluster, tmp_path,
+                                                monkeypatch):
+    """3 supervised worker subprocesses; worker 1 is killed mid-batch on
+    both send attempts of round 0 (fault budget shared across its
+    respawn via DOS_FAULTS_STATE), worker 2 drops one reply that the
+    retry recovers. The campaign completes DEGRADED with worker 1 the
+    only loss, the supervisor respawns it (twice) within the backoff
+    cap, and the counters match the injected faults."""
+    faults.reset()
+    datadir = chaos_cluster[0]
+    state = str(tmp_path / "faults-state.json")
+    monkeypatch.setenv("DOS_FAULTS",
+                       "kill-mid-batch;wid=1;times=2,"
+                       "drop-reply;wid=2;times=1")
+    monkeypatch.setenv("DOS_FAULTS_STATE", state)
+    # the timeout must outlive a worker respawn (jax import + engine
+    # load in the fresh subprocess), so the retry meets the REPLACEMENT
+    # server — whose read of the retry request triggers kill #2
+    monkeypatch.setenv("DOS_SEND_TIMEOUT_S", "90")
+    monkeypatch.setenv("DOS_RETRY_MAX", "1")
+    monkeypatch.setenv("DOS_RETRY_BASE_S", "0.2")
+    monkeypatch.setenv("DOS_RETRY_JITTER", "0")
+    conf, conf_path = _conf(chaos_cluster, "conf-chaos.json",
+                            diffs=["-", "-"])
+    fifo_dir = str(tmp_path / "fifos")
+    os.makedirs(fifo_dir)
+    monkeypatch.setattr(
+        pq, "command_fifo_path",
+        lambda wid: os.path.join(fifo_dir, f"worker{wid}.fifo"))
+    sup = WorkerSupervisor(conf, conf_path, fifo_dir=fifo_dir,
+                           logdir=str(tmp_path / "logs"),
+                           ping_interval_s=1.0, backoff_base_s=0.2,
+                           backoff_cap_s=5.0, probe_timeout_s=5.0)
+    respawns0 = sup_mod.M_RESPAWNS.value
+    retries0 = fifo_mod.M_RETRIES.value
+    outdir = str(tmp_path / "artifacts-chaos")
+    sup.start(wait_ready_s=300)
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host",
+                      "-o", outdir])
+        assert rc == pq.EXIT_DEGRADED
+        man = json.load(open(os.path.join(outdir, "degraded.json")))
+        # worker 1 lost exactly its round-0 batch (both attempts
+        # killed); worker 2's drop was recovered by the retry and must
+        # NOT appear
+        assert man["failed_workers"] == [1]
+        assert [(f["wid"], f["round"]) for f in man["failed_batches"]] \
+            == [(1, 0)]
+        assert man["total_batches"] == 2 * N_WORKERS
+        # retries: worker 1 round 0 (+1) and worker 2's dropped reply
+        # (+1) — both booked on head_retries_total
+        assert fifo_mod.M_RETRIES.value - retries0 == 2
+        # the supervisor respawned worker 1 once per kill, within the
+        # backoff cap: the respawned server answered round 1 (otherwise
+        # (1, 1) would be in the failure list)
+        assert sup.workers[1].respawns == 2
+        assert sup_mod.M_RESPAWNS.value - respawns0 == 2
+        assert sup.workers[0].respawns == 0
+        assert sup.workers[2].respawns == 0
+        # worker 2 really dropped one (and only one) data reply: read
+        # its counter over the liveness wire
+        st = fifo_mod.probe(
+            "localhost", 2,
+            command_fifo=os.path.join(fifo_dir, "worker2.fifo"),
+            nfs=datadir, timeout=10.0)
+        assert st is not None and st.ok
+        assert st.dropped == 1
+        # the injected kill consumed its full cross-process budget
+        counts = json.load(open(state))
+        kill_counts = counts["0"]
+        assert kill_counts["fired"] == 2
+        # respawned worker 1 is healthy again and served round 1
+        st1 = fifo_mod.probe(
+            "localhost", 1,
+            command_fifo=os.path.join(fifo_dir, "worker1.fifo"),
+            nfs=datadir, timeout=10.0)
+        assert st1 is not None and st1.ok and st1.batches >= 1
+    finally:
+        sup.stop()
+    assert all(w.proc.poll() is not None for w in sup.workers.values())

@@ -1,0 +1,328 @@
+"""Deterministic fault-injection harness + server-side fault paths.
+
+Every injection point is exercised against a real ``FifoServer`` serve
+loop (the bare-server pattern from test_obs: no engine needed — only a
+successfully decoded request touches it), and each recovery path is
+asserted through its obs counter via a metrics snapshot, per the
+fault-path smoke-job contract.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.testing import faults
+from distributed_oracle_search_tpu.transport.wire import HealthStatus
+from distributed_oracle_search_tpu.worker import server as server_mod
+from distributed_oracle_search_tpu.worker.server import FifoServer
+
+
+# ------------------------------------------------------------ spec parsing
+
+def test_parse_faults_grammar():
+    rules = faults.parse_faults(
+        "drop-reply;wid=2;times=3,delay;delay=0.25;times=inf;after=1,"
+        "kill-mid-batch;mode=raise")
+    assert [r.point for r in rules] == ["drop-reply", "delay",
+                                       "kill-mid-batch"]
+    assert rules[0].wid == 2 and rules[0].times == 3
+    assert rules[1].wid is None and rules[1].delay == 0.25
+    assert rules[1].times == float("inf") and rules[1].after == 1
+    assert rules[2].mode == "raise"
+    assert [r.index for r in rules] == [0, 1, 2]
+
+
+@pytest.mark.parametrize("bad", [
+    "no-such-point", "drop-reply;times", "drop-reply;x=1",
+    "kill-mid-batch;mode=explode",
+])
+def test_parse_faults_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        faults.parse_faults(bad)
+
+
+def test_injector_counts_times_after_and_wid():
+    inj = faults.FaultInjector(faults.parse_faults(
+        "crash-engine;wid=1;times=2;after=1"))
+    assert inj.fire("crash-engine", wid=0) is None       # wid filter
+    assert inj.fire("drop-reply", wid=1) is None         # point filter
+    assert inj.fire("crash-engine", wid=1) is None       # after=1 skip
+    assert inj.fire("crash-engine", wid=1) is not None   # fire 1
+    assert inj.fire("crash-engine", wid=1) is not None   # fire 2
+    assert inj.fire("crash-engine", wid=1) is None       # times spent
+
+
+def test_injector_shared_state_file_spans_processes(tmp_path):
+    """Two injector instances (= two processes) sharing DOS_FAULTS_STATE
+    consume ONE fire budget: the kill that must happen exactly once per
+    campaign stays exactly-once across a supervisor respawn."""
+    state = str(tmp_path / "faults.state.json")
+    rules = "kill-mid-batch;times=1"
+    a = faults.FaultInjector(faults.parse_faults(rules), state_path=state)
+    b = faults.FaultInjector(faults.parse_faults(rules), state_path=state)
+    assert a.fire("kill-mid-batch", wid=1) is not None
+    assert b.fire("kill-mid-batch", wid=1) is None       # budget spent
+    counts = json.load(open(state))
+    assert counts["0"]["fired"] == 1 and counts["0"]["seen"] == 2
+
+
+def test_inject_is_noop_without_env(monkeypatch):
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+    assert faults.inject("drop-reply", wid=0) is None
+
+
+def test_inject_rearms_on_env_change(monkeypatch):
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "delay;delay=0.1;times=1")
+    assert faults.inject("delay").delay == 0.1
+    assert faults.inject("delay") is None
+    monkeypatch.setenv("DOS_FAULTS", "delay;delay=0.2;times=1")
+    assert faults.inject("delay").delay == 0.2
+
+
+# --------------------------------------------------- server fault paths
+
+def _bare_server(tmp_path, name, wid=0):
+    s = FifoServer.__new__(FifoServer)
+    s.wid = wid
+    s.command_fifo = str(tmp_path / f"{name}.fifo")
+    return s
+
+
+def _serve(server):
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    for _ in range(100):
+        if os.path.exists(server.command_fifo):
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("server fifo never appeared")
+    return th
+
+
+def _request_lines(answer):
+    return '{"itrs": 1}\n' + f"/no/such/queryfile {answer} -\n"
+
+
+def _counters():
+    snap = obs_metrics.REGISTRY.snapshot()["counters"]
+    return {
+        "dropped": snap["server_replies_dropped_total"],
+        "batch_fail": snap["server_batches_failed_total"],
+        "replies": snap["server_replies_sent_total"],
+        "injected": snap["faults_injected_total"],
+    }
+
+
+def test_server_crash_engine_fault_answers_fail(tmp_path, monkeypatch):
+    """crash-engine: the batch is answered with FAIL (the head is never
+    left blocked) and server_batches_failed_total books it."""
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "crash-engine;wid=0;times=1")
+    s = _bare_server(tmp_path, "crash")
+    answer = str(tmp_path / "crash.answer")
+    os.mkfifo(answer)
+    before = _counters()
+    th = _serve(s)
+    try:
+        with open(s.command_fifo, "w") as f:
+            f.write(_request_lines(answer))
+        with open(answer) as f:
+            assert f.readline().strip() == "FAIL"
+    finally:
+        server_mod.stop_server(s.command_fifo)
+        th.join(timeout=10)
+    after = _counters()
+    assert after["batch_fail"] == before["batch_fail"] + 1
+    assert after["injected"] == before["injected"] + 1
+
+
+def test_server_drop_reply_fault_counts_dropped(tmp_path, monkeypatch):
+    """drop-reply: the server handles the batch but never answers; the
+    drop is booked on server_replies_dropped_total and the NEXT request
+    is answered normally (times=1 spent)."""
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "drop-reply;wid=0;times=1")
+    s = _bare_server(tmp_path, "drop")
+    a0, a1 = str(tmp_path / "a0.fifo"), str(tmp_path / "a1.fifo")
+    os.mkfifo(a0)
+    os.mkfifo(a1)
+    before = _counters()
+    th = _serve(s)
+    got = []
+
+    def read_answer(path):
+        fd = os.open(path, os.O_RDONLY | os.O_NONBLOCK)
+        try:
+            deadline = time.monotonic() + 5
+            buf = b""
+            while time.monotonic() < deadline and b"\n" not in buf:
+                try:
+                    chunk = os.read(fd, 4096)
+                except BlockingIOError:
+                    chunk = b""
+                if chunk:
+                    buf += chunk
+                else:
+                    time.sleep(0.02)
+            got.append(buf.decode())
+        finally:
+            os.close(fd)
+
+    try:
+        with open(s.command_fifo, "w") as f:
+            f.write(_request_lines(a0))
+        t0 = threading.Thread(target=read_answer, args=(a0,))
+        t0.start()
+        t0.join()
+        assert got == [""]                       # reply dropped
+        with open(s.command_fifo, "w") as f:
+            f.write(_request_lines(a1))
+        t1 = threading.Thread(target=read_answer, args=(a1,))
+        t1.start()
+        t1.join()
+        assert got[1].strip() == "FAIL"          # bare server: engine err
+    finally:
+        server_mod.stop_server(s.command_fifo)
+        th.join(timeout=10)
+    after = _counters()
+    assert after["dropped"] == before["dropped"] + 1
+    assert after["replies"] == before["replies"] + 1
+
+
+def test_server_delay_fault_delays_reply(tmp_path, monkeypatch):
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "delay;wid=0;delay=0.4;times=1")
+    s = _bare_server(tmp_path, "slow")
+    answer = str(tmp_path / "slow.answer")
+    os.mkfifo(answer)
+    th = _serve(s)
+    try:
+        t0 = time.monotonic()
+        with open(s.command_fifo, "w") as f:
+            f.write(_request_lines(answer))
+        with open(answer) as f:
+            assert f.readline().strip() == "FAIL"
+        assert time.monotonic() - t0 >= 0.4
+    finally:
+        server_mod.stop_server(s.command_fifo)
+        th.join(timeout=10)
+
+
+def test_server_kill_mid_batch_raise_mode_dies_without_reply(
+        tmp_path, monkeypatch):
+    """kill-mid-batch (mode=raise, the in-thread variant): the serve
+    loop dies after reading the request and before any reply — the
+    injected analog of the reference's head-wedging worker crash."""
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS",
+                       "kill-mid-batch;wid=0;times=1;mode=raise")
+    s = _bare_server(tmp_path, "kill")
+    answer = str(tmp_path / "kill.answer")
+    os.mkfifo(answer)
+    before = _counters()
+    th = _serve(s)
+    with open(s.command_fifo, "w") as f:
+        f.write(_request_lines(answer))
+    th.join(timeout=10)
+    assert not th.is_alive()                     # server died mid-batch
+    after = _counters()
+    assert after["replies"] == before["replies"]
+    assert after["injected"] == before["injected"] + 1
+
+
+def test_server_ping_health_line(tmp_path):
+    """__DOS_PING__ control frame: one HealthStatus JSON line back, and
+    data-plane counters untouched (pings are not frames)."""
+    s = _bare_server(tmp_path, "ping", wid=7)
+    answer = str(tmp_path / "ping.answer")
+    os.mkfifo(answer)
+    frames_before = server_mod.M_FRAMES.value
+    th = _serve(s)
+    try:
+        with open(s.command_fifo, "w") as f:
+            f.write(f"__DOS_PING__ {answer}\n")
+        with open(answer) as f:
+            st = HealthStatus.from_json(f.readline())
+        assert st.ok and st.wid == 7 and st.pid == os.getpid()
+        assert st.uptime_s >= 0 and st.batches == 0
+    finally:
+        server_mod.stop_server(s.command_fifo)
+        th.join(timeout=10)
+    assert server_mod.M_FRAMES.value == frames_before
+
+
+def test_server_health_reflects_failures(tmp_path, monkeypatch):
+    """batches / batch_failures / last_error in the health line move
+    with the serve loop's actual outcomes."""
+    faults.reset()
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+    s = _bare_server(tmp_path, "hstate")
+    answer = str(tmp_path / "hstate.answer")
+    os.mkfifo(answer)
+    th = _serve(s)
+    try:
+        with open(s.command_fifo, "w") as f:     # bare server: FAILs
+            f.write(_request_lines(answer))
+        with open(answer) as f:
+            assert f.readline().strip() == "FAIL"
+        with open(s.command_fifo, "w") as f:
+            f.write(f"__DOS_PING__ {answer}\n")
+        with open(answer) as f:
+            st = HealthStatus.from_json(f.readline())
+        assert st.batches == 1 and st.batch_failures == 1
+        assert st.last_error != ""
+    finally:
+        server_mod.stop_server(s.command_fifo)
+        th.join(timeout=10)
+
+
+# ------------------------------------------- DOS_FAULTS permutation smoke
+
+@pytest.mark.parametrize("spec,expect", [
+    ("crash-engine;times=2", {"server_batches_failed_total": 2}),
+    ("drop-reply;times=1", {"server_replies_dropped_total": 1}),
+    # request 0 crashes via injection, request 1 fails naturally (the
+    # bare server has no engine) and its reply is dropped: 2 failed
+    # batches, 1 dropped reply
+    ("crash-engine;times=1,drop-reply;times=1;after=1",
+     {"server_batches_failed_total": 2,
+      "server_replies_dropped_total": 1}),
+])
+def test_fault_permutations_move_exactly_their_counters(
+        tmp_path, monkeypatch, spec, expect):
+    """The tier-1 fault-path smoke: each DOS_FAULTS permutation moves
+    exactly the counters it should, asserted via a registry snapshot."""
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", spec)
+    s = _bare_server(tmp_path, "perm")
+    before = obs_metrics.REGISTRY.snapshot()["counters"]
+    th = _serve(s)
+    try:
+        for i in range(2):
+            answer = str(tmp_path / f"perm{i}.answer")
+            os.mkfifo(answer)
+            with open(s.command_fifo, "w") as f:
+                f.write(_request_lines(answer))
+            # drain the answer (or observe the drop) without blocking
+            fd = os.open(answer, os.O_RDONLY | os.O_NONBLOCK)
+            deadline = time.monotonic() + 5
+            buf = b""
+            while time.monotonic() < deadline and b"\n" not in buf:
+                try:
+                    buf += os.read(fd, 4096) or b""
+                except BlockingIOError:
+                    pass
+                time.sleep(0.02)
+            os.close(fd)
+    finally:
+        server_mod.stop_server(s.command_fifo)
+        th.join(timeout=10)
+    after = obs_metrics.REGISTRY.snapshot()["counters"]
+    for name, delta in expect.items():
+        assert after[name] - before[name] == delta, name

@@ -1,0 +1,302 @@
+"""Head-side resilience: retry policy, per-attempt answer FIFOs (the
+stale-reply race fix), circuit breaker state machine, liveness probes,
+non-wedging stop, and stale-FIFO cleanup."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.testing import faults
+from distributed_oracle_search_tpu.transport import fifo as fifo_mod
+from distributed_oracle_search_tpu.transport import resilience
+from distributed_oracle_search_tpu.transport.fifo import (
+    RetryPolicy, clean_stale_answer_fifos, probe, send_with_retry,
+)
+from distributed_oracle_search_tpu.transport.wire import (
+    HealthStatus, Request, RuntimeConfig, StatsRow,
+)
+from distributed_oracle_search_tpu.worker import server as server_mod
+from distributed_oracle_search_tpu.worker.server import (
+    FifoServer, stop_server,
+)
+
+
+# -------------------------------------------------------------- RetryPolicy
+
+def test_retry_policy_backoff_is_capped_exponential_and_deterministic():
+    p = RetryPolicy(retries=5, base_s=0.1, cap_s=0.4, jitter=0.0)
+    assert [p.backoff_s(i) for i in range(4)] == [0.1, 0.2, 0.4, 0.4]
+    pj = RetryPolicy(base_s=0.1, cap_s=10.0, jitter=0.5)
+    a = pj.backoff_s(2, seed="answer.host3")
+    b = pj.backoff_s(2, seed="answer.host3")
+    assert a == b                       # crc32 seed: reruns identical
+    assert 0.2 <= a <= 0.6              # raw 0.4 +- 50%
+    assert pj.backoff_s(2, seed="answer.host4") != a
+
+
+def test_retry_policy_from_env(monkeypatch):
+    monkeypatch.setenv("DOS_RETRY_MAX", "3")
+    monkeypatch.setenv("DOS_RETRY_BASE_S", "0.01")
+    monkeypatch.setenv("DOS_RETRY_CAP_S", "0.05")
+    monkeypatch.setenv("DOS_RETRY_JITTER", "0")
+    p = RetryPolicy.from_env()
+    assert (p.retries, p.base_s, p.cap_s, p.jitter) == (3, 0.01, 0.05, 0)
+    monkeypatch.setenv("DOS_RETRY_MAX", "garbage")
+    assert RetryPolicy.from_env().retries == 1      # default survives
+
+
+def test_send_with_retry_uses_unique_answer_fifo_per_attempt(monkeypatch):
+    """The stale-reply race fix: every attempt reads its own FIFO and the
+    request carries that attempt's name, so a late reply to attempt N
+    can never satisfy attempt N+1."""
+    seen = []
+
+    def fake_send(host, request, command_fifo, timeout=None, wid=None):
+        seen.append(request.answerfifo)
+        return (StatsRow.failed() if len(seen) < 3
+                else StatsRow(plen=1))
+
+    monkeypatch.setattr(fifo_mod, "send", fake_send)
+    before = fifo_mod.M_RETRIES.value
+    req = Request(RuntimeConfig(), "/nfs/q", "/nfs/answer.h0", "-")
+    row = send_with_retry("localhost", req, "/tmp/w0.fifo",
+                          policy=RetryPolicy(retries=3, base_s=0.0,
+                                             jitter=0.0))
+    assert row.ok
+    assert seen == ["/nfs/answer.h0.a0", "/nfs/answer.h0.a1",
+                    "/nfs/answer.h0.a2"]
+    assert fifo_mod.M_RETRIES.value == before + 2
+
+
+def test_stale_reply_race_end_to_end(tmp_path, monkeypatch):
+    """A delayed worker reply outlives the head's first attempt; the
+    retry must get a FRESH reply while the stale one dies in attempt 0's
+    own FIFO. With a shared FIFO name the late reply would land in the
+    retry's read instead."""
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "delay;wid=0;delay=3.0;times=1")
+    # the stale reply finds attempt 0's reader dead: drop it fast
+    # instead of stalling the serve loop for the default 30s
+    monkeypatch.setenv("DOS_REPLY_DEADLINE_S", "0.3")
+    s = FifoServer.__new__(FifoServer)
+    s.wid = 0
+    s.command_fifo = str(tmp_path / "w0.fifo")
+    th = threading.Thread(target=s.serve_forever, daemon=True)
+    th.start()
+    for _ in range(100):
+        if os.path.exists(s.command_fifo):
+            break
+        time.sleep(0.02)
+    replies_before = server_mod.M_REPLIES.value
+    dropped_before = server_mod.M_DROPPED.value
+    try:
+        req = Request(RuntimeConfig(), "/no/such/queryfile",
+                      str(tmp_path / "answer.h0"), "-")
+        # attempt 0 times out at 2s (server sleeping 3s); the retry's
+        # request is read after the sleep and answered immediately
+        row = send_with_retry(
+            "localhost", req, s.command_fifo, timeout=2.0,
+            policy=RetryPolicy(retries=1, base_s=0.05, jitter=0.0))
+        # bare server answers FAIL (no engine), via the FRESH attempt:
+        # exactly one reply delivered (to attempt 1's FIFO) and exactly
+        # one dropped (the stale one, into attempt 0's dead FIFO)
+        assert not row.ok
+        deadline = time.monotonic() + 5
+        while (server_mod.M_REPLIES.value == replies_before
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert server_mod.M_REPLIES.value == replies_before + 1
+        assert server_mod.M_DROPPED.value == dropped_before + 1
+    finally:
+        server_mod.stop_server(s.command_fifo)
+        th.join(timeout=15)
+
+
+# ------------------------------------------------------------------- probe
+
+def test_probe_live_server_returns_health(tmp_path):
+    s = FifoServer.__new__(FifoServer)
+    s.wid = 3
+    s.command_fifo = str(tmp_path / "w3.fifo")
+    th = threading.Thread(target=s.serve_forever, daemon=True)
+    th.start()
+    for _ in range(100):
+        if os.path.exists(s.command_fifo):
+            break
+        time.sleep(0.02)
+    try:
+        st = probe("localhost", 3, command_fifo=s.command_fifo,
+                   nfs=str(tmp_path), timeout=5.0)
+        assert st is not None and st.ok and st.wid == 3
+        assert st.uptime_s >= 0.0
+    finally:
+        server_mod.stop_server(s.command_fifo)
+        th.join(timeout=10)
+    # probe cleaned its answer FIFO
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("answer.ping.")]
+
+
+def test_probe_dead_server_fails_fast_no_fifo(tmp_path):
+    before = fifo_mod.M_PROBE_FAILURES.value
+    t0 = time.monotonic()
+    st = probe("localhost", 9,
+               command_fifo=str(tmp_path / "absent.fifo"),
+               nfs=str(tmp_path), timeout=3.0)
+    assert st is None
+    assert time.monotonic() - t0 < 3.0           # [ -p ] guard, no wait
+    assert fifo_mod.M_PROBE_FAILURES.value == before + 1
+
+
+def test_probe_crashed_server_stale_fifo_bounded(tmp_path):
+    """A hard crash leaves the command FIFO with no reader: the probe's
+    write-open must time out instead of wedging like the failure it is
+    detecting."""
+    stale = str(tmp_path / "crashed.fifo")
+    os.mkfifo(stale)
+    t0 = time.monotonic()
+    st = probe("localhost", 4, command_fifo=stale, nfs=str(tmp_path),
+               timeout=2.0)
+    assert st is None
+    assert time.monotonic() - t0 < 8.0
+
+
+# ---------------------------------------------------------- circuit breaker
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_after_threshold_and_cooldown_half_opens():
+    clk = FakeClock()
+    opened = resilience.M_OPENED.value
+    rejected = resilience.M_REJECTED.value
+    br = resilience.CircuitBreaker(("h", 0), threshold=3, cooldown_s=5.0,
+                                   clock=clk)
+    for _ in range(2):
+        assert br.allow()
+        br.record(False)
+    assert br.state == resilience.CLOSED
+    assert br.allow()
+    br.record(False)                              # 3rd consecutive
+    assert br.state == resilience.OPEN
+    assert resilience.M_OPENED.value == opened + 1
+    assert not br.allow()                         # short-circuited
+    assert resilience.M_REJECTED.value == rejected + 1
+    clk.t += 5.1                                  # cooldown fallback
+    assert br.allow()                             # the half-open trial
+    assert br.state == resilience.HALF_OPEN
+    assert not br.allow()                         # one trial at a time
+    br.record(True)
+    assert br.state == resilience.CLOSED
+    assert br.allow()
+
+
+def test_breaker_failed_trial_reopens():
+    clk = FakeClock()
+    br = resilience.CircuitBreaker(("h", 1), threshold=1, cooldown_s=2.0,
+                                   clock=clk)
+    assert br.allow()
+    br.record(False)
+    assert br.state == resilience.OPEN
+    clk.t += 2.1
+    assert br.allow()
+    br.record(False)
+    assert br.state == resilience.OPEN            # back to OPEN
+    assert not br.allow()
+
+
+def test_registry_background_probe_half_opens_and_shuts_down():
+    """An OPEN breaker is healed by the registry's background probe
+    (named dos-probe-*, joined by shutdown — the leak check in conftest
+    would fail otherwise)."""
+    healthy = threading.Event()
+
+    def probe_fn(key):
+        return HealthStatus(ok=True) if healthy.is_set() else None
+
+    reg = resilience.BreakerRegistry(threshold=1, cooldown_s=0.05,
+                                     probe_fn=probe_fn, enabled=True)
+    key = ("localhost", 2)
+    assert reg.allow(key)
+    reg.record(key, False)                        # -> OPEN, probe starts
+    assert reg.get(key).state == resilience.OPEN
+    time.sleep(0.2)
+    assert reg.get(key).state == resilience.OPEN  # probes keep failing
+    healthy.set()
+    deadline = time.monotonic() + 5
+    while (reg.get(key).state != resilience.HALF_OPEN
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert reg.get(key).state == resilience.HALF_OPEN
+    assert reg.allow(key)                         # the trial
+    reg.record(key, True)
+    assert reg.get(key).state == resilience.CLOSED
+    reg.shutdown()
+
+
+def test_registry_env_knobs_and_disable(monkeypatch):
+    monkeypatch.setenv("DOS_CIRCUIT_THRESHOLD", "7")
+    monkeypatch.setenv("DOS_CIRCUIT_COOLDOWN_S", "0.5")
+    reg = resilience.BreakerRegistry()
+    assert reg.threshold == 7 and reg.cooldown_s == 0.5
+    monkeypatch.setenv("DOS_CIRCUIT_DISABLE", "1")
+    reg = resilience.BreakerRegistry()
+    key = ("h", 0)
+    for _ in range(20):
+        reg.record(key, False)
+        assert reg.allow(key)                     # disabled: always allow
+    reg.shutdown()
+
+
+# ------------------------------------------------- stop_server / cleanup
+
+def test_stop_server_does_not_wedge_on_dead_server(tmp_path):
+    """The satellite fix: a leftover FIFO with no reader used to hang
+    the caller forever in a blocking open."""
+    stale = str(tmp_path / "dead.fifo")
+    os.mkfifo(stale)
+    t0 = time.monotonic()
+    assert stop_server(stale, deadline_s=0.3) is False
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_stop_server_missing_fifo_returns_false(tmp_path):
+    assert stop_server(str(tmp_path / "never-existed.fifo")) is False
+
+
+def test_stop_server_delivers_to_live_server(tmp_path):
+    s = FifoServer.__new__(FifoServer)
+    s.wid = 0
+    s.command_fifo = str(tmp_path / "live.fifo")
+    th = threading.Thread(target=s.serve_forever, daemon=True)
+    th.start()
+    for _ in range(100):
+        if os.path.exists(s.command_fifo):
+            break
+        time.sleep(0.02)
+    assert stop_server(s.command_fifo) is True
+    th.join(timeout=10)
+    assert not th.is_alive()
+
+
+def test_clean_stale_answer_fifos(tmp_path):
+    os.mkfifo(str(tmp_path / "answer.host0"))
+    os.mkfifo(str(tmp_path / "answer.host1.a2"))
+    with open(str(tmp_path / "answer.notafifo"), "w") as f:
+        f.write("regular file, not ours to delete")
+    with open(str(tmp_path / "query.host0"), "w") as f:
+        f.write("1\n0 1\n")
+    before = fifo_mod.M_STALE_CLEANED.value
+    assert clean_stale_answer_fifos(str(tmp_path)) == 2
+    assert sorted(os.listdir(tmp_path)) == ["answer.notafifo",
+                                            "query.host0"]
+    assert fifo_mod.M_STALE_CLEANED.value == before + 2
+    assert clean_stale_answer_fifos(str(tmp_path)) == 0

@@ -1,0 +1,171 @@
+"""Worker supervisor: launch, monitor, respawn with capped backoff.
+
+Real ``worker.server`` subprocesses cost a JAX import + engine load
+each, so these tests supervise cheap dummy processes through the
+injectable ``spawn_fn``/``probe_fn`` seams; the full stack (subprocess
+servers, real pings, a mid-campaign kill) runs in the slow chaos test
+(test_chaos.py)."""
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_oracle_search_tpu.transport.wire import HealthStatus
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker import supervisor as sup_mod
+from distributed_oracle_search_tpu.worker.supervisor import (
+    WorkerSupervisor,
+)
+
+
+def _conf(n=2):
+    return ClusterConfig(workers=["localhost"] * n, partmethod="mod",
+                         partkey=n)
+
+
+def _dummy_spawn(w):
+    return subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(600)"],
+                            start_new_session=True)
+
+
+def _alive_probe(w):
+    if w.proc is not None and w.proc.poll() is None:
+        return HealthStatus(ok=True, wid=w.wid)
+    return None
+
+
+def _mk(n=2, **kw):
+    kw.setdefault("spawn_fn", _dummy_spawn)
+    kw.setdefault("probe_fn", _alive_probe)
+    kw.setdefault("ping_interval_s", 0.05)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_cap_s", 0.2)
+    return WorkerSupervisor(_conf(n), conf_path=None, **kw)
+
+
+def test_supervisor_starts_monitors_and_stops():
+    sup = _mk(2)
+    sup.start(wait_ready_s=10)
+    try:
+        assert all(w.proc.poll() is None for w in sup.workers.values())
+        assert sup_mod.G_ALIVE.value == 2
+        names = [t.name for t in threading.enumerate()]
+        assert "dos-supervisor" in names
+    finally:
+        sup.stop()
+    assert all(w.proc.poll() is not None for w in sup.workers.values())
+    assert sup_mod.G_ALIVE.value == 0
+    assert "dos-supervisor" not in [t.name for t in
+                                    threading.enumerate()
+                                    if t.is_alive()]
+
+
+def test_supervisor_respawns_dead_worker_with_backoff():
+    respawns_before = sup_mod.M_RESPAWNS.value
+    sup = _mk(2)
+    sup.start(wait_ready_s=10)
+    try:
+        victim = sup.workers[0]
+        old_pid = victim.proc.pid
+        victim.proc.kill()
+        deadline = time.monotonic() + 10
+        while (victim.respawns == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert victim.respawns == 1
+        assert victim.proc.pid != old_pid
+        assert victim.proc.poll() is None        # replacement running
+        assert sup_mod.M_RESPAWNS.value == respawns_before + 1
+        # the survivor was never touched
+        assert sup.workers[1].respawns == 0
+        # a good ping resets the backoff step for the next crash
+        deadline = time.monotonic() + 5
+        while (victim.backoff_k != 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert victim.backoff_k == 0
+    finally:
+        sup.stop()
+
+
+def test_supervisor_backoff_caps():
+    """A worker that dies instantly on every spawn backs off
+    exponentially and the delay never exceeds the cap."""
+    def doomed_spawn(w):
+        return subprocess.Popen([sys.executable, "-c", "pass"])
+
+    # probe never succeeds, so the backoff step is never reset by a
+    # "came up healthy" observation racing the instant death
+    sup = _mk(1, backoff_base_s=0.05, backoff_cap_s=0.15,
+              probe_fn=lambda w: None)
+    # bypass start(): install the doomed worker and run the monitor
+    sup.spawn_fn = doomed_spawn
+    w = sup.workers[0]
+    w.proc = doomed_spawn(w)
+    w.proc.wait()
+    t = threading.Thread(target=sup._monitor, daemon=True,
+                         name="dos-supervisor")
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while w.respawns < 4 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert w.respawns >= 4
+        assert sup._backoff_s(w) == 0.15         # capped
+    finally:
+        sup._stop.set()
+        t.join(timeout=5)
+        if w.proc is not None and w.proc.poll() is None:
+            w.proc.kill()
+            w.proc.wait()
+        sup_mod.G_ALIVE.set(0)
+
+
+def test_supervisor_hung_worker_optin_respawn():
+    """Ping-based respawn is opt-in (unhealthy_pings): a live process
+    whose pings keep failing is killed and relaunched."""
+    sup = _mk(1, unhealthy_pings=3,
+              probe_fn=lambda w: None)           # every ping fails
+    w = sup.workers[0]
+    w.proc = _dummy_spawn(w)
+    w.healthy_once = True
+    t = threading.Thread(target=sup._monitor, daemon=True,
+                         name="dos-supervisor")
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while w.respawns == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert w.respawns >= 1
+    finally:
+        sup._stop.set()
+        t.join(timeout=5)
+        if w.proc is not None and w.proc.poll() is None:
+            w.proc.kill()
+            w.proc.wait()
+        sup_mod.G_ALIVE.set(0)
+
+
+def test_supervisor_start_fails_loudly_when_worker_never_ready():
+    sup = _mk(1, probe_fn=lambda w: None)
+    with pytest.raises(RuntimeError, match="not ready"):
+        sup.start(wait_ready_s=0.5)
+    sup.stop()
+
+
+def test_supervisor_env_knobs(monkeypatch):
+    monkeypatch.setenv("DOS_SUPERVISOR_PING_S", "9")
+    monkeypatch.setenv("DOS_SUPERVISOR_BACKOFF_BASE_S", "0.25")
+    monkeypatch.setenv("DOS_SUPERVISOR_BACKOFF_CAP_S", "3")
+    monkeypatch.setenv("DOS_SUPERVISOR_UNHEALTHY_PINGS", "5")
+    sup = WorkerSupervisor(_conf(1), conf_path=None,
+                           spawn_fn=_dummy_spawn,
+                           probe_fn=_alive_probe)
+    assert sup.ping_interval_s == 9
+    assert sup.backoff_base_s == 0.25
+    assert sup.backoff_cap_s == 3
+    assert sup.unhealthy_pings == 5
