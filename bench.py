@@ -25,6 +25,10 @@ grid + arterials; see ``data/synth.py``). Sections (env-gated):
              (subprocess), decomposed into mesh wall-clock vs per-shard
              single-device time, plus shard strong scaling on the real
              chip                                     (BENCH_WEAK=0 skips)
+  serve      online serving frontend (serving/): closed-loop capacity,
+             then an open-loop Poisson drill at a fraction of measured
+             capacity — q/s, p50/p95/p99 latency, zipf cache hit rate,
+             mean micro-batch fill                   (BENCH_SERVE=0 skips)
 
 All speedups are against a MEASURED native-engine run on this host's
 cpu_cores core(s); *_parity_cores fields give the OpenMP core count a
@@ -1416,6 +1420,126 @@ def main() -> None:
         finally:
             shutil.rmtree(sdir, ignore_errors=True)
 
+    # ---- online serving: open-loop Poisson load against the serving
+    # frontend (serving/) backed by the resident oracle — throughput,
+    # p50/p95/p99 latency, cache hit rate on a zipf-skewed workload, and
+    # the micro-batcher's realized batch fill. Offered load is set to a
+    # fraction of MEASURED closed-loop capacity so the figures are
+    # comparable across hosts of very different speed. BENCH_SERVE=0
+    # skips.
+    serve_stats = {}
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        from distributed_oracle_search_tpu.obs import (
+            metrics as _serve_obs,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            CallableDispatcher, ServeConfig, ServingFrontend,
+        )
+
+        log("online serving (Poisson open loop on the resident "
+            "oracle)...")
+        sb = int(os.environ.get("BENCH_SERVE_BATCH", 256))
+        if sb & (sb - 1):
+            # ServeConfig requires a pow2 max_batch (compiled-program
+            # reuse is the thing being measured); round up, loudly
+            sb2 = 1 << (sb - 1).bit_length()
+            log(f"BENCH_SERVE_BATCH={sb} is not a power of two; "
+                f"using {sb2}")
+            sb = sb2
+        sn = int(os.environ.get("BENCH_SERVE_REQUESTS", 10_000))
+        util = float(os.environ.get("BENCH_SERVE_UTIL", 0.7))
+        rng = np.random.default_rng(17)
+        pool = queries[rng.zipf(1.3, size=sn).clip(1, len(queries)) - 1]
+
+        def _oracle_dispatch(wid, q, rconf, diff):
+            return oracle.query(q)
+
+        # closed-loop capacity: saturate the frontend (submit everything
+        # at once) to measure what the shards can actually drain
+        sconf = ServeConfig(queue_depth=max(sn, 1024), max_batch=sb,
+                            max_wait_ms=2.0, deadline_ms=600_000.0,
+                            cache_bytes=0).validate()
+        fe = ServingFrontend(dc, CallableDispatcher(_oracle_dispatch),
+                             sconf=sconf)
+        fe.start()
+        for b in (1, sb // 4, sb):            # warm the program shapes
+            fe_futs = [fe.submit(int(s), int(t))
+                       for s, t in queries[:b]]
+            for f in fe_futs:
+                f.result(600)
+        t0 = time.perf_counter()
+        futs = [fe.submit(int(s), int(t)) for s, t in pool]
+        for f in futs:
+            f.result(600)
+        cap_s = time.perf_counter() - t0
+        fe.stop()
+        capacity_qps = sn / cap_s
+        log(f"serve capacity (closed loop): {sn} in {cap_s:.2f}s -> "
+            f"{capacity_qps:,.0f} q/s")
+
+        # open loop at util * capacity, cache ON (the skewed workload's
+        # steady state), latency measured request-by-request against the
+        # Poisson arrival clock
+        offered = capacity_qps * util
+        snap0 = _serve_obs.REGISTRY.snapshot()
+        fe = ServingFrontend(dc, CallableDispatcher(_oracle_dispatch),
+                             sconf=ServeConfig(
+                                 queue_depth=4096, max_batch=sb,
+                                 max_wait_ms=2.0,
+                                 deadline_ms=60_000.0).validate())
+        fe.start()
+        arrivals = np.cumsum(rng.exponential(1.0 / offered, size=sn))
+        t0 = time.perf_counter()
+        mono0 = time.monotonic()
+        futs = []
+        for (s, t), at in zip(pool, arrivals):
+            now = time.perf_counter() - t0
+            if at > now:
+                time.sleep(at - now)
+            futs.append(fe.submit(int(s), int(t)))
+        results = [f.result(600) for f in futs]
+        wall_s = time.perf_counter() - t0
+        fe.stop()
+        lat_ms = (np.array([r.t_done for r in results])
+                  - (mono0 + arrivals)) * 1e3
+        ok = np.array([r.ok for r in results])
+        snap1 = _serve_obs.REGISTRY.snapshot()
+
+        def _cdelta(name):
+            return (snap1["counters"].get(name, 0)
+                    - snap0["counters"].get(name, 0))
+
+        fill0 = snap0["histograms"]["serve_batch_fill"]
+        fill1 = snap1["histograms"]["serve_batch_fill"]
+        nb = fill1["count"] - fill0["count"]
+        mean_fill = (fill1["sum"] - fill0["sum"]) / max(nb, 1)
+        hits = _cdelta("serve_cache_hits_total")
+        misses = _cdelta("serve_cache_misses_total")
+        # an all-shed/all-error drill must degrade the figures, not
+        # crash the run after every earlier section's work
+        p50, p95, p99 = ((float(np.percentile(lat_ms[ok], q))
+                          for q in (50, 95, 99)) if ok.any()
+                         else (float("nan"),) * 3)
+        serve_stats = {
+            "serve_capacity_queries_per_sec": round(capacity_qps, 1),
+            "serve_offered_queries_per_sec": round(offered, 1),
+            "serve_queries_per_sec": round(int(ok.sum()) / wall_s, 1),
+            "serve_p50_ms": round(p50, 3),
+            "serve_p95_ms": round(p95, 3),
+            "serve_p99_ms": round(p99, 3),
+            "serve_shed": int(len(results) - ok.sum()),
+            "serve_cache_hit_rate": round(hits / max(hits + misses, 1),
+                                          3),
+            "serve_mean_batch_fill": round(mean_fill, 1),
+            "serve_batches": int(nb),
+        }
+        log(f"serve open loop at {offered:,.0f} q/s offered: "
+            f"{serve_stats['serve_queries_per_sec']:,.0f} q/s served, "
+            f"p50/p95/p99 {p50:.2f}/{p95:.2f}/{p99:.2f} ms, "
+            f"cache hit rate {serve_stats['serve_cache_hit_rate']:.0%}, "
+            f"mean batch fill {mean_fill:.1f}, "
+            f"shed {serve_stats['serve_shed']}")
+
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     detail = {
         "graph_nodes": g.n,
@@ -1450,6 +1574,7 @@ def main() -> None:
         **scale_stats,
         **road_stats,
         **weak_stats,
+        **serve_stats,
         "devices": len(devices),
         "platform": devices[0].platform,
     }
@@ -1487,7 +1612,10 @@ def main() -> None:
         "road_build_parity_cores", "road_tpu_build_rows_per_sec",
         "road_stream_queries_per_sec", "road_resident_queries_per_sec",
         "road_tpu_resident_speedup", "road_multidiff_fused_speedup",
-        "shard_strong_scaling_rows_per_sec", "devices", "platform",
+        "shard_strong_scaling_rows_per_sec",
+        "serve_queries_per_sec", "serve_p99_ms",
+        "serve_cache_hit_rate", "serve_mean_batch_fill",
+        "devices", "platform",
     )
     headline = {k: detail[k] for k in headline_keys if k in detail}
     headline["walk_gather_utilization"] = \

@@ -1,0 +1,173 @@
+"""Always-on oracle service: the online counterpart of the campaign
+drivers (``dos-serve``).
+
+Where ``cli.process_query`` answers a whole scenario file and exits,
+this entry point keeps a :class:`~..serving.ServingFrontend` resident
+and feeds it from a line-protocol ingress (stdin by default; a unix
+socket or a tailed file for external producers). Two backends:
+
+* ``--backend inproc`` (default) — shard engines live in this process
+  (one :class:`~..worker.engine.ShardEngine` per worker; missing CPD
+  shards are built on first use so ``--test`` works from a bare
+  checkout);
+* ``--backend host`` — the campaign wire against resident
+  ``worker.server`` processes (launch them with ``dos-make-fifos``),
+  with the per-worker circuit breakers + background healing probes the
+  campaign path uses; per-query answers return via the
+  ``RuntimeConfig.results`` sidecar wire extension.
+
+Serving knobs come from ``DOS_SERVE_*`` env vars, overridable by flags
+(``--max-batch``, ``--max-wait-ms``, ``--queue-depth``,
+``--cache-bytes``, ``--deadline-ms``). ``--metrics-dump PATH`` writes
+the obs snapshot on shutdown — queue depths, batch-fill and
+time-to-flush histograms, cache hit/miss counters, end-to-end request
+latencies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..obs import metrics as obs_metrics
+from ..serving import (
+    EngineDispatcher, FifoDispatcher, ServeConfig, ServingFrontend,
+)
+from ..serving import ingress
+from ..transport import fifo as fifo_transport
+from ..transport import resilience
+from ..transport.fifo import command_fifo_path
+from ..transport.wire import RuntimeConfig
+from ..utils.config import ClusterConfig, test_config
+from ..utils.log import get_logger, set_verbosity
+
+log = get_logger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serve", description=__doc__.splitlines()[0])
+    p.add_argument("-c", default="./example-cluster-conf.json",
+                   help="cluster config JSON")
+    p.add_argument("-t", "--test", action="store_true",
+                   help="serve the canned synthetic dataset (builds "
+                        "missing CPD shards in-process)")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    p.add_argument("--backend", default="inproc",
+                   choices=["inproc", "host"],
+                   help="inproc: shard engines in this process; host: "
+                        "FIFO wire to resident worker servers")
+    p.add_argument("--alg", default="table-search",
+                   choices=["table-search", "astar"],
+                   help="serving algorithm (inproc backend)")
+    p.add_argument("--diff", default=None,
+                   help="active congestion diff (default: the conf's "
+                        "first diff, '-' = free flow)")
+    p.add_argument("--ingress", default="stdin",
+                   choices=["stdin", "socket", "tail"],
+                   help="where 's t' request lines come from")
+    p.add_argument("--socket", default="/tmp/dos-serve.sock",
+                   help="unix socket path (--ingress socket)")
+    p.add_argument("--tail", default=None,
+                   help="request file to follow (--ingress tail); "
+                        "answers append to <file>.answers")
+    p.add_argument("--queue-depth", type=int, default=None,
+                   help="per-shard queue bound (DOS_SERVE_QUEUE_DEPTH)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="micro-batch flush size, power of two "
+                        "(DOS_SERVE_MAX_BATCH)")
+    p.add_argument("--max-wait-ms", type=float, default=None,
+                   help="micro-batch wait bound (DOS_SERVE_MAX_WAIT_MS)")
+    p.add_argument("--cache-bytes", type=int, default=None,
+                   help="result-cache budget, 0 disables "
+                        "(DOS_SERVE_CACHE_BYTES)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request deadline (DOS_SERVE_DEADLINE_MS)")
+    p.add_argument("--metrics-dump", default="",
+                   help="write a JSON metrics snapshot here on shutdown")
+    return p
+
+
+def build_frontend(conf: ClusterConfig, args):
+    """Frontend + (for the host backend) the breaker registry the
+    caller must shut down."""
+    sconf = ServeConfig.from_env(
+        queue_depth=args.queue_depth, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, cache_bytes=args.cache_bytes,
+        deadline_ms=args.deadline_ms)
+    rconf = RuntimeConfig()
+    diff = args.diff if args.diff is not None else (
+        conf.diffs[0] if conf.diffs else "-")
+    registry = None
+    breaker_key = None
+    if args.backend == "host":
+        if conf.is_tpu:
+            raise SystemExit(
+                "--backend host needs host-mode workers; partmethod=tpu "
+                "shards live on the device mesh (use --backend inproc)")
+        dispatcher = FifoDispatcher(conf)
+        registry = resilience.BreakerRegistry(
+            probe_fn=lambda key: fifo_transport.probe(
+                key[0], key[1], command_fifo=command_fifo_path(key[1]),
+                nfs=conf.nfs))
+        breaker_key = lambda wid: (conf.workers[wid], wid)  # noqa: E731
+    else:
+        dispatcher = EngineDispatcher(conf, alg=args.alg,
+                                      build_missing=args.test)
+    frontend = ServingFrontend(
+        dispatcher.dc if args.backend == "inproc" else _dc_for(conf),
+        dispatcher, sconf=sconf, rconf=rconf, diff=diff,
+        registry=registry, breaker_key=breaker_key)
+    return frontend, registry
+
+
+def _dc_for(conf: ClusterConfig):
+    from ..data.formats import xy_node_count
+    from ..parallel.partition import DistributionController
+
+    return DistributionController(conf.partmethod, conf.partkey,
+                                  conf.maxworker, xy_node_count(
+                                      conf.xy_file))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    set_verbosity(args.verbose)
+    if args.test:
+        from ..data.synth import ensure_synth_dataset
+
+        # the canned tpu-partition config: contiguous shards that match
+        # the checked-in synth index layout; the inproc backend serves
+        # any partmethod (shard engines only need the block files)
+        conf = test_config()
+        ensure_synth_dataset(os.path.dirname(conf.xy_file) or "./data")
+    else:
+        conf = ClusterConfig.load(args.c)
+    frontend, registry = build_frontend(conf, args)
+    frontend.start()
+    try:
+        if args.ingress == "stdin":
+            n = ingress.serve_stdin(frontend)
+        elif args.ingress == "socket":
+            ingress.serve_unix_socket(frontend, args.socket)
+            n = None
+        else:
+            if not args.tail:
+                raise SystemExit("--ingress tail needs --tail FILE")
+            n = ingress.tail_file(frontend, args.tail)
+        if n is not None:
+            log.info("ingress closed after %d request(s)", n)
+    except KeyboardInterrupt:
+        log.info("interrupted; draining")
+    finally:
+        frontend.stop()
+        if registry is not None:
+            registry.shutdown()
+        if args.metrics_dump:
+            obs_metrics.REGISTRY.dump_json(args.metrics_dump)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,26 @@ through one of these):
 * fault harness — ``faults_injected_total`` (``DOS_FAULTS`` rules that
   fired; in a chaos run the recovery counters above should move in
   lock-step with it).
+
+Online serving layer (``serving/`` — the open-workload frontend; every
+admission decision, batch, and cache outcome is visible):
+
+* requests — ``serve_requests_total`` / ``serve_requests_ok_total``,
+  end-to-end ``serve_request_seconds`` (submit → completion, cache hits
+  included);
+* admission control — ``serve_shed_busy_total`` (queue full),
+  ``serve_shed_unavailable_total`` (open breaker / shutdown),
+  ``serve_timeouts_total`` (deadline expired while queued),
+  ``serve_errors_total``; ``serve_queue_depth`` gauge;
+* micro-batching — ``serve_batches_total``, ``serve_batch_fill`` and
+  ``serve_time_to_flush_seconds`` histograms (is coalescing working?),
+  ``serve_flush_full_total`` vs ``serve_flush_wait_total`` (which
+  trigger fired), ``serve_dispatch_seconds``,
+  ``serve_batches_in_flight`` gauge;
+* result cache — ``serve_cache_{hits,misses,evictions}_total``,
+  ``serve_cache_{entries,bytes}`` gauges;
+* worker-side dedup (the batch-level twin of the cache) —
+  ``worker_duplicate_queries_total``.
 """
 
 from . import metrics, trace

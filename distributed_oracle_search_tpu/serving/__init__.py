@@ -1,0 +1,62 @@
+"""Online serving layer: request queues, adaptive micro-batching, and a
+result cache on top of the shard oracle.
+
+The campaign drivers (``cli.process_query``) answer a *closed* workload:
+a whole scenario file partitioned once, one batch per worker per diff
+round, then exit. This package is the *open*-workload shape — the
+standard online-inference frontend (continuous/adaptive batching a la
+Orca / Clipper-style prediction-serving), built on the transport,
+resilience, and observability layers the campaign path already uses:
+
+* :class:`~.frontend.ServingFrontend` — accepts single ``s t`` queries,
+  routes each to its target-owner shard via the
+  ``DistributionController``, and applies admission control: a full
+  per-shard queue sheds ``BUSY``, an OPEN circuit breaker sheds
+  ``UNAVAILABLE`` — never a silent hang;
+* :class:`~.queue.ShardQueue` — bounded per-shard request queue with
+  per-request deadlines (expired requests complete ``TIMEOUT``);
+* :class:`~.batcher.MicroBatcher` — per-shard adaptive micro-batcher:
+  flushes when the batch hits the power-of-two ``max_batch`` (so
+  workers reuse the handful of compiled programs ``ShardEngine`` keys
+  on ``qpad``) or when ``max_wait_ms`` elapses, and keeps exactly ONE
+  batch in flight per shard so host-side batch forming pipelines with
+  device execution;
+* :class:`~.cache.ResultCache` — bounded LRU keyed on
+  ``(s, t, diff, knob fingerprint)``, short-circuiting repeats on
+  skewed traffic; invalidated on diff change;
+* :mod:`~.dispatch` — the shard backends: in-process
+  :class:`~.dispatch.EngineDispatcher` (one ``ShardEngine`` per shard)
+  and :class:`~.dispatch.FifoDispatcher` (the campaign wire +
+  ``transport.send_with_retry``, per-query answers returned via the
+  ``RuntimeConfig.results`` sidecar extension);
+* :mod:`~.ingress` — the line protocol (stdin / unix socket /
+  file-tail): one ``s t`` per line in, one result line out, responses
+  in request order.
+
+Entry point: ``python -m distributed_oracle_search_tpu.cli.serve``
+(``dos-serve``). Env knobs: ``DOS_SERVE_QUEUE_DEPTH``,
+``DOS_SERVE_MAX_BATCH``, ``DOS_SERVE_MAX_WAIT_MS``,
+``DOS_SERVE_CACHE_BYTES``, ``DOS_SERVE_DEADLINE_MS`` (see
+:class:`~.config.ServeConfig`).
+"""
+
+from .batcher import MicroBatcher
+from .cache import ResultCache, knob_fingerprint
+from .config import ServeConfig
+from .dispatch import (
+    CallableDispatcher, DispatchError, EngineDispatcher, FifoDispatcher,
+)
+from .frontend import ServingFrontend
+from .queue import ShardQueue
+from .request import (
+    BUSY, ERROR, Future, OK, ServeRequest, ServeResult, TIMEOUT,
+    UNAVAILABLE,
+)
+
+__all__ = [
+    "BUSY", "CallableDispatcher", "DispatchError", "ERROR",
+    "EngineDispatcher", "FifoDispatcher", "Future", "MicroBatcher", "OK",
+    "ResultCache", "ServeConfig", "ServeRequest", "ServeResult",
+    "ServingFrontend", "ShardQueue", "TIMEOUT", "UNAVAILABLE",
+    "knob_fingerprint",
+]

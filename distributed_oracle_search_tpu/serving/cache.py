@@ -1,0 +1,114 @@
+"""Bounded LRU result cache for skewed online traffic.
+
+Keyed on ``(s, t, diff, knob fingerprint)`` — everything that can change
+an answer. The diff is part of the key, so entries from different
+congestion rounds never collide; the frontend still calls
+:meth:`ResultCache.invalidate` on a diff *change* because a diff *path*
+can be rewritten in place (the engine's own weight cache has the same
+``no_cache`` hatch for that reason).
+
+Capacity is a byte budget, not an entry count: entries are fixed-size
+(three small ints under a small tuple key), so the budget divides by a
+conservative per-entry estimate (``ENTRY_BYTES``) into a max entry
+count. Thread-safe — the frontend reads on the submit path while shard
+batcher threads fill on the completion path.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..obs import metrics as obs_metrics
+
+#: conservative per-entry budget: key tuple (4 elements + a short diff
+#: string) + 3-int value tuple + OrderedDict node overhead, measured
+#: ~230 bytes on CPython 3.10; rounded up so the budget errs small
+ENTRY_BYTES = 256
+
+M_HITS = obs_metrics.counter(
+    "serve_cache_hits_total", "requests short-circuited by the cache")
+M_MISSES = obs_metrics.counter(
+    "serve_cache_misses_total", "cache lookups that fell through")
+M_EVICT = obs_metrics.counter(
+    "serve_cache_evictions_total", "LRU entries evicted at the budget")
+G_ENTRIES = obs_metrics.gauge(
+    "serve_cache_entries", "entries resident in the result cache")
+G_BYTES = obs_metrics.gauge(
+    "serve_cache_bytes", "estimated bytes resident in the result cache")
+
+
+def knob_fingerprint(config) -> tuple:
+    """The answer-affecting subset of :class:`~..transport.wire.
+    RuntimeConfig`: two frontends sharing a cache (or one frontend
+    reconfigured) must never serve an answer computed under different
+    knobs. ``threads``/``thread_alloc``/``verbose`` are presentation or
+    no-op knobs and stay out; ``itrs`` repeats the same computation
+    (last result wins) so it stays out too."""
+    return (config.hscale, config.fscale, config.time, config.k_moves,
+            config.debug, config.no_cache)
+
+
+class ResultCache:
+    """LRU over ``key -> (cost, plen, finished)``."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self.max_entries = self.max_bytes // ENTRY_BYTES
+        self._od: OrderedDict[tuple, tuple] = OrderedDict()
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_entries > 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._od)
+
+    def get(self, key: tuple):
+        """``(cost, plen, finished)`` or None; books hit/miss."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            entry = self._od.get(key)
+            if entry is None:
+                M_MISSES.inc()
+                return None
+            self._od.move_to_end(key)
+            M_HITS.inc()
+            return entry
+
+    def put(self, key: tuple, value: tuple) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            if key in self._od:
+                self._od.move_to_end(key)
+                self._od[key] = value
+                return
+            self._od[key] = value
+            while len(self._od) > self.max_entries:
+                self._od.popitem(last=False)
+                M_EVICT.inc()
+            self._set_gauges_locked()
+
+    def invalidate(self, diff: str | None = None) -> int:
+        """Drop every entry (``diff=None``) or only one diff's entries;
+        returns how many were dropped. Called on diff change — see the
+        module docstring for why keys alone are not enough."""
+        with self._lock:
+            if diff is None:
+                n = len(self._od)
+                self._od.clear()
+            else:
+                doomed = [k for k in self._od if k[2] == diff]
+                for k in doomed:
+                    del self._od[k]
+                n = len(doomed)
+            self._set_gauges_locked()
+        return n
+
+    def _set_gauges_locked(self) -> None:
+        G_ENTRIES.set(len(self._od))
+        G_BYTES.set(len(self._od) * ENTRY_BYTES)

@@ -1,0 +1,189 @@
+"""Shard backends for the serving frontend.
+
+A dispatcher answers one shard's batch:
+``answer_batch(wid, queries [Q, 2], rconf, diff) -> (cost, plen,
+finished)`` with each output aligned to ``queries``. Failures raise
+:class:`DispatchError` (or anything else) — the frontend turns that
+into per-request ``ERROR`` results and a circuit-breaker failure
+record.
+
+* :class:`EngineDispatcher` — in-process: one
+  :class:`~..worker.engine.ShardEngine` per shard, built lazily on the
+  shard's first batch (and optionally building missing CPD shard files
+  on the spot, which is what lets ``dos-serve --test`` run from a bare
+  checkout).
+* :class:`FifoDispatcher` — the campaign wire against resident
+  ``worker.server`` processes: per-batch query file into the shared
+  dir, request through the command FIFO via
+  ``transport.send_with_retry`` (capped-backoff retries, per-attempt
+  answer FIFOs), and per-query answers read back from the
+  ``<queryfile>.results`` sidecar (``RuntimeConfig.results`` wire
+  extension).
+* :class:`CallableDispatcher` — adapter for tests and the bench's
+  resident-oracle serving mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import threading
+
+import numpy as np
+
+from ..parallel.partition import DistributionController
+from ..transport import fifo as fifo_transport
+from ..transport.fifo import answer_fifo_path, command_fifo_path
+from ..transport.wire import (
+    Request, RuntimeConfig, read_results_file, results_file_for,
+    write_query_file,
+)
+from ..utils.config import ClusterConfig
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class DispatchError(RuntimeError):
+    """A shard batch could not be answered."""
+
+
+class EngineDispatcher:
+    """In-process shard engines (the ``--backend inproc`` serving path
+    and the smoke-test harness)."""
+
+    def __init__(self, conf: ClusterConfig, graph=None,
+                 dc: DistributionController | None = None,
+                 alg: str = "table-search", build_missing: bool = False,
+                 build_chunk: int = 512):
+        from ..data.graph import Graph
+
+        self.conf = conf
+        self.graph = graph if graph is not None else Graph.from_xy(
+            conf.xy_file)
+        self.dc = dc if dc is not None else DistributionController(
+            conf.partmethod, conf.partkey, conf.maxworker, self.graph.n)
+        self.alg = alg
+        self.build_missing = build_missing
+        self.build_chunk = build_chunk
+        self._engines: dict[int, object] = {}
+        self._lock = threading.Lock()
+
+    def _engine_for(self, wid: int):
+        from ..worker.engine import ShardEngine
+
+        with self._lock:
+            eng = self._engines.get(wid)
+            if eng is None:
+                try:
+                    eng = ShardEngine(self.graph, self.dc, wid,
+                                      self.conf.outdir, alg=self.alg)
+                except FileNotFoundError:
+                    if not self.build_missing:
+                        raise
+                    from ..models.cpd import build_worker_shard
+
+                    log.info("no CPD shard for worker %d in %s; building "
+                             "in-process", wid, self.conf.outdir)
+                    os.makedirs(self.conf.outdir, exist_ok=True)
+                    build_worker_shard(self.graph, self.dc, wid,
+                                       self.conf.outdir,
+                                       chunk=self.build_chunk)
+                    eng = ShardEngine(self.graph, self.dc, wid,
+                                      self.conf.outdir, alg=self.alg)
+                self._engines[wid] = eng
+            return eng
+
+    def answer_batch(self, wid: int, queries: np.ndarray,
+                     rconf: RuntimeConfig, diff: str):
+        cost, plen, fin, _stats = self._engine_for(wid).answer(
+            queries, rconf, diff)
+        return cost, plen, fin
+
+
+class FifoDispatcher:
+    """Wire dispatch to resident workers. Every batch gets UNIQUE
+    ``query.serve.*`` / answer-FIFO names (pid + per-shard sequence):
+    a timed-out batch's request stays queued in the worker's command
+    FIFO with no way to cancel it, and its late ``.results`` write must
+    land in that batch's own file — never be mistaken for (or tear the
+    bytes of) a newer batch's sidecar. The previous batch's files are
+    swept on the shard's next dispatch (one batch in flight per shard,
+    so by then the old reply either landed or lost). Serving answer
+    FIFOs stay disjoint from campaign ones (``answer.<host><wid>``) so
+    a campaign sharing the nfs dir cannot cross replies with the
+    frontend."""
+
+    def __init__(self, conf: ClusterConfig,
+                 timeout: float | None = None,
+                 policy: fifo_transport.RetryPolicy | None = None):
+        self.conf = conf
+        self.timeout = (timeout if timeout is not None
+                        else fifo_transport.DEFAULT_TIMEOUT)
+        self.policy = policy
+        self._seq = itertools.count()
+        self._prev_qfile: dict[int, str] = {}
+
+    def _sweep_prev(self, wid: int) -> None:
+        prev = self._prev_qfile.pop(wid, None)
+        if not prev:
+            return
+        for p in (prev, results_file_for(prev)):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """Sweep every shard's last batch files (called by
+        ``ServingFrontend.stop`` — without it each shard's FINAL
+        ``query.serve.*``/``.results`` pair would outlive the service
+        on the shared nfs dir)."""
+        for wid in list(self._prev_qfile):
+            self._sweep_prev(wid)
+
+    def answer_batch(self, wid: int, queries: np.ndarray,
+                     rconf: RuntimeConfig, diff: str):
+        host = self.conf.workers[wid]
+        nfs = self.conf.nfs
+        self._sweep_prev(wid)
+        tag = f"{os.getpid()}.{next(self._seq)}"
+        qfile = os.path.join(nfs, f"query.serve.{host}{wid}.{tag}")
+        self._prev_qfile[wid] = qfile
+        write_query_file(qfile, queries)
+        req = Request(
+            dataclasses.replace(rconf, results=True), qfile,
+            answer_fifo_path(nfs, host, wid) + f".serve.{tag}", diff)
+        row = fifo_transport.send_with_retry(
+            host, req, command_fifo_path(wid), timeout=self.timeout,
+            policy=self.policy, wid=wid)
+        if not row.ok:
+            raise DispatchError(
+                f"worker {wid} on {host} failed a serving batch "
+                f"({len(queries)} queries)")
+        try:
+            cost, plen, fin = read_results_file(results_file_for(qfile))
+        except (OSError, ValueError) as e:
+            # an old server (pre-`results` wire key) answers the stats
+            # line but writes no sidecar — a hard error here, not a
+            # silent all-zeros answer
+            raise DispatchError(
+                f"worker {wid} on {host} returned no results sidecar "
+                f"(server predates the wire extension?): {e}") from e
+        if len(cost) != len(queries):
+            raise DispatchError(
+                f"worker {wid} results length {len(cost)} != batch "
+                f"{len(queries)}")
+        return cost, plen, fin
+
+
+class CallableDispatcher:
+    """Wrap ``fn(wid, queries, rconf, diff) -> (cost, plen, finished)``."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def answer_batch(self, wid: int, queries: np.ndarray,
+                     rconf: RuntimeConfig, diff: str):
+        return self.fn(wid, queries, rconf, diff)

@@ -1,0 +1,255 @@
+"""The serving frontend: routing, admission control, cache, completion.
+
+``submit(s, t)`` is the whole online request path:
+
+1. validate the node ids against the graph;
+2. consult the :class:`~.cache.ResultCache` — a hit completes
+   immediately (``cached=True``), no queue, no batch;
+3. route to the target-owner shard (``DistributionController`` — the
+   same invariant the campaign partitioner uses: the worker owning the
+   TARGET answers);
+4. admission control: an OPEN circuit breaker for that shard's worker
+   sheds ``UNAVAILABLE``; a full shard queue sheds ``BUSY``. Both are
+   immediate — an overloaded frontend answers fast, it never hangs;
+5. enqueue with a deadline; the shard's :class:`~.batcher.MicroBatcher`
+   forms the batch and this frontend's dispatch callback answers it
+   through the configured dispatcher, records the breaker outcome,
+   fills the cache, and completes every future.
+
+Every completion stamps the end-to-end latency histogram and (when
+tracing is enabled) a ``serve.request`` span, so the online path is
+observable from day one like the campaign path.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..parallel.partition import DistributionController
+from ..transport.wire import RuntimeConfig
+from ..utils.log import get_logger
+from .batcher import MicroBatcher
+from .cache import ResultCache, knob_fingerprint
+from .config import ServeConfig
+from .queue import ShardQueue
+from .request import (
+    BUSY, ERROR, Future, OK, ServeRequest, ServeResult, TIMEOUT,
+    UNAVAILABLE,
+)
+
+log = get_logger(__name__)
+
+M_REQS = obs_metrics.counter(
+    "serve_requests_total", "requests submitted to the frontend")
+M_OK = obs_metrics.counter(
+    "serve_requests_ok_total", "requests answered OK (cache or shard)")
+M_BUSY = obs_metrics.counter(
+    "serve_shed_busy_total", "requests shed BUSY: shard queue full")
+M_UNAVAIL = obs_metrics.counter(
+    "serve_shed_unavailable_total",
+    "requests shed UNAVAILABLE: open breaker or shutdown")
+M_TIMEOUTS = obs_metrics.counter(
+    "serve_timeouts_total", "requests expired before dispatch")
+M_ERRORS = obs_metrics.counter(
+    "serve_errors_total", "requests failed by dispatch errors")
+H_E2E = obs_metrics.histogram(
+    "serve_request_seconds",
+    "submit -> completion, end to end (cache hits included)")
+
+
+class ServingFrontend:
+    """One process's online oracle service over a set of shards.
+
+    ``registry``/``breaker_key`` wire in the head-side circuit breakers
+    (``transport.resilience``): ``breaker_key(wid)`` must return the
+    same key the campaign path uses (``(host, wid)``) so breakers — and
+    their background healing probes — are shared infrastructure, not a
+    serving fork. The caller owns the registry's lifecycle
+    (``registry.shutdown()``)."""
+
+    def __init__(self, dc: DistributionController, dispatcher,
+                 sconf: ServeConfig | None = None,
+                 rconf: RuntimeConfig | None = None,
+                 diff: str = "-", registry=None, breaker_key=None):
+        self.dc = dc
+        self.dispatcher = dispatcher
+        self.sconf = sconf or ServeConfig.from_env()
+        self.rconf = rconf or RuntimeConfig()
+        self.diff = diff
+        self.registry = registry
+        self._breaker_key = breaker_key or (lambda wid: wid)
+        self._fp = knob_fingerprint(self.rconf)
+        self.cache = ResultCache(self.sconf.cache_bytes)
+        self._queues: dict[int, ShardQueue] = {}
+        self._batchers: dict[int, MicroBatcher] = {}
+        for wid in range(dc.maxworker):
+            q = ShardQueue(self.sconf.queue_depth)
+            self._queues[wid] = q
+            self._batchers[wid] = MicroBatcher(
+                wid, q,
+                (lambda batch, _wid=wid:
+                 self._dispatch_batch(_wid, batch)),
+                max_batch=self.sconf.max_batch,
+                max_wait_s=self.sconf.max_wait_s)
+        self._started = False
+        self._closed = False
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "ServingFrontend":
+        if not self._started:
+            for b in self._batchers.values():
+                b.start()
+            self._started = True
+            log.info("serving frontend up: %d shard(s), max_batch=%d, "
+                     "max_wait=%.1fms, queue_depth=%d, cache=%dMB",
+                     self.dc.maxworker, self.sconf.max_batch,
+                     self.sconf.max_wait_ms, self.sconf.queue_depth,
+                     self.sconf.cache_bytes >> 20)
+        return self
+
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Shed new requests, drain admitted ones (bounded), join the
+        batcher threads. ``drain_s`` is ONE shared budget across all
+        shards (queues close up front, shards drain concurrently), not
+        a per-shard allowance — shutdown latency stays ~drain_s even
+        with many busy shards. Idempotent."""
+        self._closed = True
+        if self._started:
+            for q in self._queues.values():
+                q.close()
+            deadline = time.monotonic() + max(drain_s, 0.0)
+            for b in self._batchers.values():
+                b.stop(drain_s=max(0.0, deadline - time.monotonic()))
+            self._started = False
+        close = getattr(self.dispatcher, "close", None)
+        if close is not None:
+            close()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, s: int, t: int) -> Future:
+        M_REQS.inc()
+        now = time.monotonic()
+        if self._closed or not self._started:
+            M_UNAVAIL.inc()
+            return self._immediate(ServeResult(
+                UNAVAILABLE, int(s), int(t), detail="not-serving"), now)
+        s, t = int(s), int(t)
+        if not (0 <= s < self.dc.nodenum and 0 <= t < self.dc.nodenum):
+            M_ERRORS.inc()
+            return self._immediate(ServeResult(
+                ERROR, s, t, detail="node-out-of-range"), now)
+        key = (s, t, self.diff, self._fp)
+        hit = self.cache.get(key)
+        if hit is not None:
+            cost, plen, fin = hit
+            M_OK.inc()
+            return self._immediate(ServeResult(
+                OK, s, t, cost=cost, plen=plen, finished=fin,
+                cached=True), now)
+        wid = int(self.dc.worker_of(t))   # scalar index, no per-request
+        # array allocation on the admission hot path
+        if (self.registry is not None
+                and not self.registry.allow(self._breaker_key(wid))):
+            M_UNAVAIL.inc()
+            return self._immediate(ServeResult(
+                UNAVAILABLE, s, t, detail="circuit-open"), now)
+        req = ServeRequest(s=s, t=t, wid=wid, key=key, t_submit=now,
+                           deadline=now + self.sconf.deadline_s)
+        if not self._queues[wid].try_put(req):
+            if self._queues[wid].closed:
+                # stop() raced this submit past the _closed check: the
+                # shed is a shutdown, not overload — label it so
+                M_UNAVAIL.inc()
+                return self._immediate(ServeResult(
+                    UNAVAILABLE, s, t, detail="not-serving"), now)
+            M_BUSY.inc()
+            return self._immediate(ServeResult(
+                BUSY, s, t, detail="queue-full"), now)
+        return req.future
+
+    def query(self, s: int, t: int,
+              timeout: float | None = None) -> ServeResult:
+        """Blocking convenience: submit and wait. The default timeout is
+        the request deadline plus dispatch headroom — a broken shard
+        still yields a terminal result, never a wedged caller."""
+        if timeout is None:
+            timeout = self.sconf.deadline_s + 30.0
+        return self.submit(s, t).result(timeout)
+
+    def set_diff(self, diff: str) -> None:
+        """Switch the active congestion diff. The cache is invalidated
+        wholesale: keys carry the diff so stale entries could never be
+        *served*, but a diff path can be rewritten in place and the
+        memory is better spent on the new round's traffic."""
+        if diff != self.diff:
+            n = self.cache.invalidate()
+            log.info("diff change %s -> %s: %d cache entries dropped",
+                     self.diff, diff, n)
+            self.diff = diff
+
+    # --------------------------------------------------------- completion
+    def _immediate(self, res: ServeResult, t_submit: float) -> Future:
+        res.t_done = time.monotonic()
+        # only served requests (cache hits) land in the latency
+        # histogram: near-zero BUSY/UNAVAILABLE shed samples would make
+        # p50/p99 IMPROVE exactly when the service is overloaded
+        if res.status == OK:
+            H_E2E.observe(res.t_done - t_submit)
+        return Future.completed(res)
+
+    def _finish(self, req: ServeRequest, res: ServeResult) -> None:
+        res.t_done = time.monotonic()
+        H_E2E.observe(res.t_done - req.t_submit)
+        obs_trace.add_span("serve.request", res.t_done - req.t_submit,
+                           wid=req.wid, status=res.status)
+        req.future.set(res)
+
+    def _dispatch_batch(self, wid: int, batch: list[ServeRequest]) -> None:
+        """MicroBatcher callback: expire, answer, record, fill, finish."""
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.expired(now):
+                M_TIMEOUTS.inc()
+                self._finish(r, ServeResult(TIMEOUT, r.s, r.t,
+                                            detail="deadline"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        queries = np.asarray([[r.s, r.t] for r in live], np.int64)
+        key = self._breaker_key(wid)
+        # pin the diff actually dispatched: a set_diff racing this batch
+        # must not let answers computed under the NEW diff be cached
+        # under requests' submit-time (old-diff) keys
+        diff = self.diff
+        err = ""
+        try:
+            with obs_trace.span("serve.dispatch", wid=wid,
+                                size=len(live)):
+                cost, plen, fin = self.dispatcher.answer_batch(
+                    wid, queries, self.rconf, diff)
+            ok = True
+        except Exception as e:  # noqa: BLE001 — any dispatch failure
+            # becomes per-request ERROR + a breaker failure record
+            log.exception("shard w%d serving batch failed: %s", wid, e)
+            ok = False
+            err = f"{type(e).__name__}: {e}"
+        if self.registry is not None:
+            self.registry.record(key, ok)
+        if not ok:
+            for r in live:
+                M_ERRORS.inc()
+                self._finish(r, ServeResult(ERROR, r.s, r.t, detail=err))
+            return
+        for i, r in enumerate(live):
+            val = (int(cost[i]), int(plen[i]), bool(fin[i]))
+            if r.key[2] == diff:
+                self.cache.put(r.key, val)
+            M_OK.inc()
+            self._finish(r, ServeResult(OK, r.s, r.t, cost=val[0],
+                                        plen=val[1], finished=val[2]))

@@ -69,6 +69,14 @@ class RuntimeConfig:
     the head's and worker's halves of one batch join on this id. Same
     compat contract as ``extract``: old peers filter the unknown key,
     and ``""`` (the default) disables capture.
+
+    ``results`` is the online-serving wire extension (``serving``): the
+    reference's campaign wire only ever returns aggregate batch stats —
+    per-query costs stay on the workers. A serving frontend needs them
+    back, so ``results=True`` asks the server to materialize each
+    query's ``cost plen finished`` into ``<queryfile>.results`` next to
+    the query file (the ``.paths`` sidecar pattern; stats CSV wire
+    unchanged). Same compat contract as ``extract``/``trace_id``.
     """
 
     hscale: float = 1.0
@@ -83,6 +91,7 @@ class RuntimeConfig:
     no_cache: bool = False
     extract: bool = False
     trace_id: str = ""
+    results: bool = False
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -229,6 +238,48 @@ def read_paths_file(path: str) -> tuple[np.ndarray, np.ndarray]:
         raise ValueError(f"{path}: header says {(q, k + 2)}, "
                          f"found {out.shape}")
     return out[:, 1:], out[:, 0]
+
+
+# ---------------------------------------------------------- results files
+
+def results_file_for(queryfile: str) -> str:
+    """Where a server materializes per-query answers for a batch when the
+    request set ``RuntimeConfig.results`` (online-serving wire
+    extension)."""
+    return queryfile + ".results"
+
+
+def write_results_file(path: str, cost: np.ndarray, plen: np.ndarray,
+                       finished: np.ndarray) -> None:
+    """``Q`` header, then one ``cost plen finished`` row per query, in
+    the query file's order."""
+    cost = np.asarray(cost, np.int64)
+    plen = np.asarray(plen, np.int64)
+    fin = np.asarray(finished).astype(np.int64)
+    with open(path, "w") as f:
+        f.write(f"{len(cost)}\n")
+        np.savetxt(f, np.stack([cost, plen, fin], axis=1), fmt="%d")
+
+
+def read_results_file(path: str) -> tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]:
+    """Returns ``(cost [Q] int64, plen [Q] int64, finished [Q] bool)``."""
+    with open(path) as f:
+        header = f.readline().split()
+        if not header:
+            # a worker killed between creating the sidecar and writing
+            # the header leaves a zero-byte file — a decode error the
+            # dispatcher translates, not an opaque IndexError
+            raise ValueError(f"{path}: empty results file")
+        count = int(header[0])
+        if count == 0:
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, bool))
+        out = np.loadtxt(f, dtype=np.int64, ndmin=2)
+    if out.shape != (count, 3):
+        raise ValueError(f"{path}: header says {(count, 3)}, "
+                         f"found {out.shape}")
+    return out[:, 0], out[:, 1], out[:, 2] != 0
 
 
 # ----------------------------------------------------------- query files

@@ -55,6 +55,10 @@ M_JIT = obs_metrics.histogram(
     "split out so steady-state latency stays clean")
 M_BATCHES = obs_metrics.counter("worker_batches_total")
 M_QUERIES = obs_metrics.counter("worker_queries_total")
+M_DUPS = obs_metrics.counter(
+    "worker_duplicate_queries_total",
+    "queries answered from another identical (s, t) pair in the same "
+    "batch — the kernel only runs each distinct pair once")
 
 
 def load_shard_rows(outdir: str, wid: int) -> np.ndarray:
@@ -142,6 +146,17 @@ class ShardEngine:
         set_worker_id(self.wid)
         t0 = time.perf_counter()
         self.last_paths = None
+        queries = np.asarray(queries, np.int64).reshape(-1, 2)
+        # routing invariant FIRST — before any shard-local row lookup,
+        # so a misrouted query fails with this diagnostic instead of an
+        # opaque index/shape error out of owned_index_of or the kernel
+        if len(queries):
+            owner = self.dc.worker_of(queries[:, 1])
+            if (owner != self.wid).any():
+                bad = int((owner != self.wid).sum())
+                raise ValueError(
+                    f"shard w{self.wid} received {bad} queries for other "
+                    "workers — routing invariant violated")
         with obs_trace.span("worker.weights", wid=self.wid,
                             difffile=difffile):
             w_pad = self._weights_for(difffile, config.no_cache)
@@ -154,33 +169,42 @@ class ShardEngine:
                     np.zeros(0, np.int64))
             return (np.zeros(0, np.int64), np.zeros(0, np.int64),
                     np.zeros(0, bool), StatsRow())
+        # dedupe identical (s, t) pairs: skewed/online traffic repeats
+        # pairs, and the kernel only needs each distinct pair once —
+        # answers fan back out through `inverse`, the same machinery
+        # as the length-sort's `unsort` below. The A* path keeps the raw
+        # batch (its per-query deadline semantics and priority-queue
+        # counters measure the work actually done).
+        if self.alg == "astar":
+            uniq, inverse = queries, None
+        else:
+            uniq, inverse = np.unique(queries, axis=0,
+                                      return_inverse=True)
+            inverse = inverse.reshape(-1)
+            if len(uniq) < nq:
+                M_DUPS.inc(nq - len(uniq))
+        nu = len(uniq)
         # order by expected walk length so the kernel's bucketed
         # while_loops exit early (the same trick as CPDOracle.route;
         # answers are unsorted back before returning)
         from ..models.cpd import length_estimate
 
         order = np.argsort(
-            length_estimate(self.graph, queries[:, 0], queries[:, 1]),
+            length_estimate(self.graph, uniq[:, 0], uniq[:, 1]),
             kind="stable")
         unsort = np.argsort(order)
-        qsorted = queries[order]
+        qsorted = uniq[order]
         # pad to the next power of two: stable shapes, no recompiles as the
         # per-worker batch size shifts between campaigns
-        qpad = 1 << (nq - 1).bit_length()
+        qpad = 1 << (nu - 1).bit_length()
         s = np.zeros(qpad, np.int32)
         t = np.zeros(qpad, np.int32)
         valid = np.zeros(qpad, bool)
-        s[:nq] = qsorted[:, 0]
-        t[:nq] = qsorted[:, 1]
-        valid[:nq] = True
+        s[:nu] = qsorted[:, 0]
+        t[:nu] = qsorted[:, 1]
+        valid[:nu] = True
         rows = np.zeros(qpad, np.int32)
-        rows[:nq] = self.dc.owned_index_of(qsorted[:, 1])
-        owner = self.dc.worker_of(queries[:, 1])
-        if (owner != self.wid).any():
-            bad = int((owner != self.wid).sum())
-            raise ValueError(
-                f"shard w{self.wid} received {bad} queries for other "
-                "workers — routing invariant violated")
+        rows[:nu] = self.dc.owned_index_of(qsorted[:, 1])
 
         t1 = time.perf_counter()
         M_RECEIVE.observe(t1 - t0)
@@ -270,15 +294,22 @@ class ShardEngine:
             nodes, moves = extract_paths(
                 self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
                 jnp.asarray(t), k=config.k_moves)
-            self.last_paths = (
-                np.asarray(nodes[:nq], np.int64)[unsort],
-                np.asarray(moves[:nq], np.int64)[unsort])
+            nodes = np.asarray(nodes[:nu], np.int64)[unsort]
+            moves = np.asarray(moves[:nu], np.int64)[unsort]
+            if inverse is not None:
+                nodes, moves = nodes[inverse], moves[inverse]
+            self.last_paths = (nodes, moves)
         t2 = time.perf_counter()
         self._finish_search(jit_key, first_call, nq, t2 - t1)
 
-        cost = np.asarray(cost[:nq], np.int64)[unsort]
-        plen = np.asarray(plen[:nq], np.int64)[unsort]
-        fin = np.asarray(fin[:nq], bool)[unsort]
+        cost = np.asarray(cost[:nu], np.int64)[unsort]
+        plen = np.asarray(plen[:nu], np.int64)[unsort]
+        fin = np.asarray(fin[:nu], bool)[unsort]
+        if inverse is not None:
+            # fan deduped answers back out to every original query —
+            # the stats sums below stay per ORIGINAL query by summing
+            # AFTER this expansion
+            cost, plen, fin = cost[inverse], plen[inverse], fin[inverse]
         stats = StatsRow(
             n_expanded=int(plen.sum()),   # node expansions = moves walked
             n_touched=nq,

@@ -31,7 +31,8 @@ from ..parallel.partition import DistributionController
 from ..testing import faults
 from ..transport.wire import (
     HealthStatus, PING_TOKEN, Request, StatsRow, paths_file_for,
-    read_query_file, write_paths_file,
+    read_query_file, results_file_for, write_paths_file,
+    write_results_file,
 )
 from ..transport.fifo import command_fifo_path
 from ..utils.config import ClusterConfig
@@ -115,13 +116,19 @@ class FifoServer:
         with obs_trace.span("worker.receive", wid=self.wid,
                             queryfile=req.queryfile):
             queries = read_query_file(req.queryfile)
-        _, _, _, stats = self.engine.answer(queries, req.config,
-                                            req.difffile)
+        cost, plen, fin, stats = self.engine.answer(queries, req.config,
+                                                    req.difffile)
         if self.engine.last_paths is not None:
             # extraction rides the shared dir, not the stats FIFO (wire
             # extension: transport.wire.paths_file_for)
             write_paths_file(paths_file_for(req.queryfile),
                              *self.engine.last_paths)
+        if req.config.results:
+            # per-query answers for the online serving frontend — same
+            # shared-dir sidecar pattern as .paths (wire extension:
+            # transport.wire.results_file_for)
+            write_results_file(results_file_for(req.queryfile),
+                               cost, plen, fin)
         return stats
 
     def serve_forever(self) -> None:

@@ -116,6 +116,68 @@ def test_shard_engine_rejects_misrouted(host_conf, built_index):
         eng.answer(other, pq.runtime_config(parse_args([])))
 
 
+def test_shard_engine_owner_check_precedes_row_lookup(
+        host_conf, built_index, monkeypatch):
+    """Regression: the routing-invariant diagnostic must fire BEFORE the
+    shard-local row lookup — a misrouted query used to crash inside
+    ``owned_index_of`` with an opaque index error instead."""
+    conf, _ = host_conf
+    g, dc = built_index
+    queries = read_scen(conf.scenfile)
+    other = queries[dc.worker_of(queries[:, 1]) == 0][:4]
+    eng = ShardEngine(g, dc, wid=1, outdir=conf.outdir)
+
+    def boom(nodes):
+        raise AssertionError("row lookup ran before the owner check")
+
+    monkeypatch.setattr(eng.dc, "owned_index_of", boom)
+    with pytest.raises(ValueError, match="routing invariant"):
+        eng.answer(other, pq.runtime_config(parse_args([])))
+
+
+def test_shard_engine_dedups_duplicates_and_zero_length(
+        host_conf, built_index):
+    """Sort/unsort path under duplicate and ``s == t`` queries: answers
+    stay element-wise equal to the reference CPU oracle, stats counters
+    (``finished``, ``plen``, ``n_touched``) count per ORIGINAL query,
+    and the dedup counter books the kernel's saved work."""
+    from distributed_oracle_search_tpu.models.reference import (
+        first_move_to_target, table_search_walk,
+    )
+    from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+
+    conf, _ = host_conf
+    g, dc = built_index
+    queries = read_scen(conf.scenfile)
+    mine = queries[dc.worker_of(queries[:, 1]) == 1][:12]
+    own = dc.owned(1)[:3]
+    batch = np.concatenate([mine, mine[:5],                 # duplicates
+                            np.stack([own, own], axis=1)])  # s == t
+    batch = batch[np.random.default_rng(3).permutation(len(batch))]
+    eng = ShardEngine(g, dc, wid=1, outdir=conf.outdir)
+    dup0 = obs_metrics.REGISTRY.snapshot()["counters"][
+        "worker_duplicate_queries_total"]
+    cost, plen, fin, stats = eng.answer(
+        batch, pq.runtime_config(parse_args([])))
+    fm_cols = {int(t): first_move_to_target(g, int(t))
+               for t in set(batch[:, 1].tolist())}
+    for (s, t), c, p, f in zip(batch, cost, plen, fin):
+        gc, gp, gf, _path = table_search_walk(
+            g, lambda x, tt: fm_cols[int(tt)][x], int(s), int(t))
+        assert (c, p, f) == (gc, gp, gf), (s, t)
+        if s == t:
+            assert p == 0 and f
+    assert fin.all()
+    # per-original-query stats despite the kernel answering dedup'd
+    assert stats.finished == len(batch)
+    assert stats.n_touched == len(batch)
+    assert stats.plen == int(plen.sum())
+    dup1 = obs_metrics.REGISTRY.snapshot()["counters"][
+        "worker_duplicate_queries_total"]
+    n_dup = len(batch) - len(np.unique(batch, axis=0))
+    assert n_dup >= 5 and dup1 - dup0 == n_dup
+
+
 def test_host_campaign_over_fifo(host_conf, built_index, monkeypatch,
                                  tmp_path):
     """Full host-mode campaign through the real FIFO wire protocol."""

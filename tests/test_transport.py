@@ -9,7 +9,8 @@ import pytest
 
 from distributed_oracle_search_tpu.transport.wire import (
     ENGINE_STAT_FIELDS, Request, RuntimeConfig, StatsRow,
-    read_query_file, write_query_file,
+    read_query_file, read_results_file, results_file_for,
+    write_query_file, write_results_file,
 )
 from distributed_oracle_search_tpu.transport.fifo import make_script
 
@@ -34,6 +35,43 @@ def test_runtime_config_trace_id_wire_extension():
     assert RuntimeConfig.from_json(rc.to_json()).trace_id == \
         "deadbeef/w1.d0"
     assert RuntimeConfig.from_json('{"hscale": 1.0}').trace_id == ""
+
+
+def test_runtime_config_results_wire_extension():
+    """``results`` (the serving per-query-answers sidecar ask) follows
+    the same compat contract as ``extract``/``trace_id``: preserved by a
+    new peer, defaulted False when an old-schema peer omits it."""
+    rc = RuntimeConfig(results=True)
+    assert RuntimeConfig.from_json(rc.to_json()).results is True
+    assert RuntimeConfig.from_json('{"hscale": 1.0}').results is False
+
+
+def test_results_file_roundtrip(tmp_path):
+    path = results_file_for(str(tmp_path / "query.host0"))
+    assert path.endswith(".results")
+    cost = np.array([0, 7, 123456], np.int64)
+    plen = np.array([0, 3, 41], np.int64)
+    fin = np.array([True, True, False])
+    write_results_file(path, cost, plen, fin)
+    rc, rp, rf = read_results_file(path)
+    assert (rc == cost).all() and (rp == plen).all() and (rf == fin).all()
+    assert rf.dtype == bool
+
+
+def test_results_file_roundtrip_empty(tmp_path):
+    path = str(tmp_path / "query.empty.results")
+    write_results_file(path, np.zeros(0, np.int64), np.zeros(0, np.int64),
+                       np.zeros(0, bool))
+    rc, rp, rf = read_results_file(path)
+    assert len(rc) == len(rp) == len(rf) == 0
+
+
+def test_results_file_rejects_truncated(tmp_path):
+    path = str(tmp_path / "query.bad.results")
+    with open(path, "w") as f:
+        f.write("3\n1 2 1\n")
+    with pytest.raises(ValueError, match="header says"):
+        read_results_file(path)
 
 
 def test_request_roundtrip():
