@@ -444,6 +444,34 @@ def main() -> None:
     log(f"CPD build: {t_build_s:.2f}s ({rows_per_s:,.0f} target rows/s, "
         f"{g.n * g.n / t_build_s / 1e9:.2f} G entries/s)")
 
+    # ---- post-build integrity gate: persist the freshly built index and
+    # run the make_cpds --verify engine over it — digest/shape-check of
+    # every block against the v2 manifest. A bench run that publishes
+    # numbers off a torn/rotted index is worse than a failed run.
+    # BENCH_VERIFY=0 skips.
+    verify_stats = {}
+    if os.environ.get("BENCH_VERIFY", "1") != "0":
+        from distributed_oracle_search_tpu.models.cpd import (
+            verify_exit_code, verify_index,
+        )
+
+        vdir = tempfile.mkdtemp(prefix="dos-verify-")
+        try:
+            with Timer() as t_save:
+                oracle.save(vdir)
+            with Timer() as t_verify:
+                vreport = verify_index(vdir, dc=dc)
+            assert verify_exit_code(vreport) == 0, (
+                f"post-build integrity gate failed: {vreport}")
+            verify_stats = {
+                "verify_seconds": round(t_verify.interval, 3),
+                "verify_blocks": int(vreport["total"]),
+            }
+            log(f"post-build verify: {vreport['total']} block(s) clean "
+                f"in {t_verify.interval:.2f}s (save {t_save.interval:.2f}s)")
+        finally:
+            shutil.rmtree(vdir, ignore_errors=True)
+
     # congestion diff for the perturbed round (reference: one round/diff)
     dsrc, ddst, dw = synth_diff(g, frac=0.1, seed=2)
     w_diff = g.weights_with_diff((dsrc, ddst, dw))
@@ -1553,6 +1581,7 @@ def main() -> None:
         **table_stats,
         "cpd_build_seconds": round(t_build_s, 2),
         "cpd_rows_per_sec": round(rows_per_s, 1),
+        **verify_stats,
         "roofline": {
             "kernel_seconds": round(t_kern_s, 4),
             "peak_gather_meps": round(peak_gather / 1e6, 1),

@@ -151,6 +151,19 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
     new.add_argument("--chunk", type=int, default=0,
                      help="CPD build: target rows per build step "
                           "(0 = all owned rows at once).")
+    new.add_argument("--no-resume", action="store_true",
+                     help="make_cpds: rebuild every block from scratch "
+                          "instead of resuming off the per-worker build "
+                          "ledger (default: resume — only blocks whose "
+                          "ledger digest no longer matches the file are "
+                          "recomputed).")
+    new.add_argument("--verify", action="store_true",
+                     help="make_cpds: check-only integrity pass over the "
+                          "conf's index — every manifest block is digest/"
+                          "shape-verified in place; exits 0 clean, 3 "
+                          "degraded (some blocks bad), 4 corrupt (no "
+                          "usable manifest or no block survived), "
+                          "mirroring process_query's exit codes.")
     new.add_argument("--engine", choices=["python", "native"],
                      default="python",
                      help="Host-mode worker engine: the JAX shard engine "

@@ -15,15 +15,20 @@ for each worker start the per-worker CPD build.
   index manifest is written when all shards are present.
 
 ``-t`` runs the canned smoke config; ``-w N`` restricts to one worker
-(reference ``make_cpds.py:27-41,58-62``).
+(reference ``make_cpds.py:27-41,58-62``). ``--verify`` runs a
+check-only integrity pass over the conf's index instead of building
+(exit 0/3/4 clean/degraded/corrupt); ``--no-resume`` disables the
+ledger-based crash-resume (on by default).
 """
 
 from __future__ import annotations
 
+import json
 import sys
 
 from .args import parse_args
 from ..transport.launch import launch, session_name
+from ..utils.atomicio import sweep_stale_artifacts
 from ..utils.config import ClusterConfig, test_config
 from ..utils.log import get_logger, set_verbosity
 
@@ -31,7 +36,8 @@ log = get_logger(__name__)
 
 
 def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0,
-                     engine: str = "python") -> str:
+                     engine: str = "python",
+                     resume: bool = True) -> str:
     """The shell command a host-mode worker runs (our ``make_cpd_auto``)."""
     partkey = (" ".join(str(b) for b in conf.partkey)
                if isinstance(conf.partkey, (list, tuple))
@@ -51,17 +57,19 @@ def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0,
            f" --maxworker {conf.maxworker} --outdir {conf.outdir}")
     if chunk:
         cmd += f" --chunk {chunk}"
+    if not resume:
+        cmd += " --no-resume"
     return cmd
 
 
 def call_worker(wid: int, conf: ClusterConfig, chunk: int = 0,
-                engine: str = "python"):
+                engine: str = "python", resume: bool = True):
     """Launch one worker's build (parity: reference ``make_cpds.py:10-25``).
 
     Returns a Popen handle when the build runs as a tracked local
     subprocess, else None (tmux/ssh detached)."""
     host = conf.workers[wid]
-    cmd = worker_build_cmd(wid, conf, chunk, engine)
+    cmd = worker_build_cmd(wid, conf, chunk, engine, resume=resume)
     log.info("launch build w%d on %s: %s", wid, host, cmd)
     # prefer_track: builds are finite jobs — await local ones so the index
     # manifest can be finalized when they all complete
@@ -69,10 +77,54 @@ def call_worker(wid: int, conf: ClusterConfig, chunk: int = 0,
                   projectdir=conf.projectdir, prefer_track=True)
 
 
+def run_verify(conf: ClusterConfig) -> int:
+    """Check-only integrity pass: digest/shape-verify every manifest
+    block in place, print the report, exit 0/3/4 (clean / degraded /
+    corrupt — ``process_query``'s convention)."""
+    from ..data.formats import xy_node_count
+    from ..models.cpd import read_manifest, verify_index, verify_exit_code
+    from ..parallel.partition import DistributionController
+
+    # verify against the manifest's own block_size (a worker.build
+    # --block-size index is still a valid index); the partition
+    # quadruple is still cross-checked against the conf
+    dc_kw = {}
+    try:
+        bs = int(read_manifest(conf.outdir).get("block_size", 0))
+        if bs > 0:
+            dc_kw["block_size"] = bs
+    except (OSError, ValueError):
+        pass            # verify_index will report the unusable manifest
+    dc = DistributionController(conf.partmethod, conf.partkey,
+                                conf.maxworker,
+                                xy_node_count(conf.xy_file), **dc_kw)
+    report = verify_index(conf.outdir, dc=dc)
+    for fname in report["missing"]:
+        log.error("missing block: %s", fname)
+    for ent in report["corrupt"]:
+        log.error("corrupt block: %s (%s)", ent["file"], ent["reason"])
+    if report.get("fatal"):
+        log.error("verify fatal: %s", report["fatal"])
+    code = verify_exit_code(report)
+    print(json.dumps({"index": conf.outdir, "exit_code": code,
+                      **{k: report[k] for k in
+                         ("total", "ok", "unverified", "missing",
+                          "corrupt")},
+                      **({"fatal": report["fatal"]}
+                         if report.get("fatal") else {})}))
+    return code
+
+
 def run_tpu(conf: ClusterConfig, args) -> None:
     """In-process sharded build over the mesh."""
     from ..parallel.multihost import initialize_from_conf
     initialize_from_conf(conf)
+
+    import jax
+    if jax.process_count() == 1:
+        # debris from killed builds; skipped multi-controller (another
+        # process may have an atomic write in flight in the shared dir)
+        sweep_stale_artifacts(conf.outdir)
 
     from ..data.graph import Graph
     from ..models.cpd import CPDOracle
@@ -91,11 +143,16 @@ def run_tpu(conf: ClusterConfig, args) -> None:
 
 
 def run_host(conf: ClusterConfig, args) -> None:
+    # sweep BEFORE any worker launches: once builds are running, their
+    # own in-flight *.tmp files must not be swept out from under them
+    sweep_stale_artifacts(conf.outdir)
+    resume = not getattr(args, "no_resume", False)
     procs = []
     for wid in range(conf.maxworker):
         if args.worker != -1 and wid != args.worker:
             continue
-        proc = call_worker(wid, conf, chunk=args.chunk, engine=args.engine)
+        proc = call_worker(wid, conf, chunk=args.chunk, engine=args.engine,
+                           resume=resume)
         if proc is not None:
             procs.append((wid, proc))
     failures = 0
@@ -133,6 +190,8 @@ def main(argv=None) -> int:
         ensure_synth_dataset(os.path.dirname(conf.xy_file) or "./data")
     else:
         conf = ClusterConfig.load(args.c)
+    if getattr(args, "verify", False):
+        return run_verify(conf)
     if args.backend == "tpu" or (args.backend == "auto" and conf.is_tpu):
         run_tpu(conf, args)
     else:

@@ -47,6 +47,7 @@ from ..transport.wire import (
 from ..parallel.multihost import is_primary
 from ..transport import fifo as fifo_transport
 from ..transport import resilience
+from ..utils.atomicio import sweep_stale_artifacts
 from ..utils.config import ClusterConfig, test_config
 from ..utils.env import env_cast
 from ..utils.log import get_logger, set_verbosity
@@ -270,6 +271,8 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
     round time, so downstream tooling that aggregates per-worker columns
     gets campaign-true totals (tests pin this).
     """
+    import jax
+
     from ..data.graph import Graph
     from ..models.cpd import CPDOracle
     from ..parallel.mesh import mesh_from_config
@@ -283,6 +286,13 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
             "device analog here")
 
     graph = Graph.from_xy(conf.xy_file)
+    if jax.process_count() == 1:
+        # artifact-plane analog of run_host's stale-FIFO sweep: tmp
+        # debris / quarantined blocks from killed builds go before the
+        # build-if-missing paths below can trip on them. Skipped
+        # multi-controller — a peer process may have an atomic write in
+        # flight in the shared index dir.
+        sweep_stale_artifacts(conf.outdir)
     use_astar = alg == "astar"
     if use_astar:
         # A* searches the graph directly — no CPD index involved.
@@ -532,10 +542,12 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
     timeout = send_timeout_s(args)
     # fault-tolerance plumbing: stale FIFOs from crashed runs are swept
     # before the first batch (a killed transfer script never reaches its
-    # `rm -f`), retries follow the env-tuned backoff policy, and each
-    # worker gets a circuit breaker whose background probes ping through
-    # the same command FIFO the batches use
+    # `rm -f`), stale build artifacts (*.tmp debris, quarantined blocks)
+    # go with them, retries follow the env-tuned backoff policy, and
+    # each worker gets a circuit breaker whose background probes ping
+    # through the same command FIFO the batches use
     fifo_transport.clean_stale_answer_fifos(conf.nfs)
+    sweep_stale_artifacts(conf.outdir)
     policy = fifo_transport.RetryPolicy.from_env()
     registry = resilience.BreakerRegistry(
         probe_fn=lambda key: fifo_transport.probe(

@@ -28,7 +28,9 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import sys
+import threading
 
 from ..obs import metrics as obs_metrics
 from ..serving import (
@@ -146,26 +148,47 @@ def main(argv=None) -> int:
         conf = ClusterConfig.load(args.c)
     frontend, registry = build_frontend(conf, args)
     frontend.start()
+    # graceful drain: SIGTERM (the orchestrator's stop signal) and
+    # SIGINT both stop ingress — the event ends the socket/tail loops,
+    # the exception unwinds a blocking stdin read — then the finally
+    # block drains the bounded queues, flushes in-flight micro-batches
+    # (frontend.stop: every admitted request is answered or shed, never
+    # silently dropped), writes the final metrics dump, and exits 0.
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        if stop_evt.is_set():
+            return     # repeat signal mid-drain: keep draining
+        log.info("received %s; stopping ingress and draining",
+                 signal.Signals(signum).name)
+        stop_evt.set()
+        raise KeyboardInterrupt
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
     try:
         if args.ingress == "stdin":
             n = ingress.serve_stdin(frontend)
         elif args.ingress == "socket":
-            ingress.serve_unix_socket(frontend, args.socket)
+            ingress.serve_unix_socket(frontend, args.socket,
+                                      stop=stop_evt)
             n = None
         else:
             if not args.tail:
                 raise SystemExit("--ingress tail needs --tail FILE")
-            n = ingress.tail_file(frontend, args.tail)
+            n = ingress.tail_file(frontend, args.tail, stop=stop_evt)
         if n is not None:
             log.info("ingress closed after %d request(s)", n)
     except KeyboardInterrupt:
         log.info("interrupted; draining")
     finally:
+        stop_evt.set()
         frontend.stop()
         if registry is not None:
             registry.shutdown()
         if args.metrics_dump:
             obs_metrics.REGISTRY.dump_json(args.metrics_dump)
+        log.info("drained and stopped cleanly")
     return 0
 
 

@@ -26,6 +26,8 @@ wrong for TPU; sharding is the compression here (SURVEY.md §7 hard parts).
 from __future__ import annotations
 
 import functools
+import glob
+import io
 import json
 import os
 
@@ -34,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.graph import Graph, INF
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..ops import DeviceGraph
 from ..parallel.mesh import (
     make_mesh, worker_sharding, WORKER_AXIS, DATA_AXIS,
@@ -45,8 +49,35 @@ from ..parallel.sharded import (
     query_paths_sharded, query_sharded, query_tables_multi_sharded,
     query_tables_sharded,
 )
+from ..testing import faults
+from ..utils.atomicio import (
+    SWEEP_MIN_AGE_S, TMP_SUFFIX, atomic_save_npy, atomic_write_json,
+    digest_bytes, digest_file, quarantine,
+)
+from ..utils.log import get_logger
 
-INDEX_VERSION = 1
+log = get_logger(__name__)
+
+#: manifest schema version. v2 adds per-block content digests + shapes
+#: (``blocks``) and ``digest_algo``; readers tolerate unknown keys, so a
+#: bump is MAJOR only when existing keys change meaning — v1 indexes
+#: load under v2 code, v(N+1) indexes are rejected by vN code.
+INDEX_VERSION = 2
+
+# artifact-durability counters: every verify/quarantine/rebuild/resume
+# event in the index data plane proves it fired through one of these
+M_BLOCKS_VERIFIED = obs_metrics.counter(
+    "cpd_blocks_verified_total",
+    "CPD blocks that passed load-time digest/shape verification")
+M_BLOCKS_CORRUPT = obs_metrics.counter(
+    "cpd_blocks_corrupt_total",
+    "CPD blocks found missing/torn/digest-mismatched at load or verify")
+M_BLOCKS_REBUILT = obs_metrics.counter(
+    "cpd_blocks_rebuilt_total",
+    "corrupt CPD blocks rebuilt in place from the graph")
+M_BLOCKS_RESUMED = obs_metrics.counter(
+    "build_blocks_resumed_total",
+    "blocks skipped by a resumed build (ledger-verified complete)")
 
 #: compressed device->host fm fetch below this raw size is not worth the
 #: extra device round trip (the count pass) — plain fetch instead
@@ -148,6 +179,71 @@ def _host_tree(tree):
 
 def shard_block_name(wid: int, bid: int) -> str:
     return f"cpd-w{wid:05d}-b{bid:05d}.npy"
+
+
+def ledger_path(outdir: str, wid: int) -> str:
+    return os.path.join(outdir, f"build-w{wid:05d}.ledger")
+
+
+class BuildLedger:
+    """Per-worker build journal: one JSON line per completed,
+    digest-valid block.
+
+    The ledger is the crash-resume source of truth: a block counts as
+    done only when its line is in the journal AND the file on disk still
+    matches the recorded digest — a torn write, a swept tmp file, or
+    bit-rot all fail the check and the block is recomputed. Appends are
+    flushed+fsynced per line; a torn trailing line (crash mid-append)
+    is skipped on read, costing at most one block's recompute. Later
+    entries for the same file win, so a rebuilt block just appends."""
+
+    def __init__(self, outdir: str, wid: int):
+        self.path = ledger_path(outdir, wid)
+
+    def entries(self) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ent = json.loads(line)
+                    except ValueError:
+                        continue          # torn trailing append
+                    if isinstance(ent, dict) and "file" in ent:
+                        out[ent["file"]] = ent
+        except OSError:
+            pass
+        return out
+
+    def record(self, fname: str, digest: str, shape, dtype: str) -> None:
+        line = json.dumps({"file": fname, "digest": digest,
+                           "shape": list(shape), "dtype": dtype})
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def block_complete(outdir: str, fname: str,
+                   ledger_entries: dict[str, dict]) -> bool:
+    """Is an on-disk block safe to skip on resume? Ledgered blocks must
+    match their recorded digest; pre-ledger (legacy) blocks must at
+    least parse as a ``.npy`` — a torn legacy write fails the header or
+    size check and is rebuilt."""
+    path = os.path.join(outdir, fname)
+    if not os.path.exists(path):
+        return False
+    ent = ledger_entries.get(fname)
+    if ent is not None:
+        return digest_file(path) == ent.get("digest")
+    try:
+        np.load(path, mmap_mode="r")
+        return True
+    except Exception:  # noqa: BLE001 — any unreadable file means rebuild
+        return False
 
 
 def length_estimate(graph: Graph, s: np.ndarray, t: np.ndarray):
@@ -270,9 +366,13 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     emitting per-block CPD files; here one process builds its worker's rows
     block-by-block with the batched min-plus kernel (gather-free shift
     relaxation when the id layout allows) and writes
-    ``cpd-w<wid>-b<bid>.npy`` per block. ``resume=True`` skips blocks whose
-    file already exists — mid-build restart granularity the reference lacks
-    (SURVEY.md §5 checkpoint/resume).
+    ``cpd-w<wid>-b<bid>.npy`` per block — each through a tmp+fsync+rename
+    atomic write, journaled (file, digest, shape) in the per-worker build
+    ledger. ``resume=True`` skips blocks the ledger records as complete
+    AND whose on-disk digest still matches (legacy un-ledgered blocks are
+    accepted if they parse) — mid-build restart granularity the reference
+    lacks (SURVEY.md §5 checkpoint/resume), now safe against torn writes:
+    a build killed mid-flush recomputes exactly the missing tail.
     """
     from ..ops import build_fm_columns
     from ..ops.ell_split import build_fm_columns_ellsplit
@@ -281,6 +381,22 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     from ..ops.shift_relax import build_fm_columns_shift
 
     os.makedirs(outdir, exist_ok=True)
+    # sweep THIS worker's atomic-write debris from a killed build; the
+    # dir-wide sweep belongs to the campaign/launcher (other workers may
+    # be writing their own tmp files in this dir right now). Same age
+    # gate as the dir-wide sweep: a young tmp file may be a live write
+    # by a concurrent same-wid process (a respawned worker healing while
+    # its hung predecessor still drains) — deleting it would turn that
+    # process's rename into a crash
+    import time as _time
+    now = _time.time()
+    for p in glob.glob(os.path.join(
+            outdir, f"cpd-w{wid:05d}-*{TMP_SUFFIX}.*")):
+        try:
+            if now - os.path.getmtime(p) >= SWEEP_MIN_AGE_S:
+                os.remove(p)
+        except OSError:
+            pass
     owned = dc.owned(wid)
     bs = dc.block_size
     # compute granularity (device working set) is independent of the file
@@ -289,10 +405,21 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     chunk = chunk if chunk > 0 else max(len(owned), 1)
     n_blocks = (len(owned) + bs - 1) // bs
     # only the missing blocks are computed — a restart after a partial
-    # build pays exactly for what is not yet on disk
-    missing = [bid for bid in range(n_blocks)
-               if not (resume and os.path.exists(
-                   os.path.join(outdir, shard_block_name(wid, bid))))]
+    # build pays exactly for what is not yet on disk, and "on disk"
+    # means ledger-journaled with a matching digest, not merely named
+    ledger = BuildLedger(outdir, wid)
+    entries = ledger.entries() if resume else {}
+    missing, resumed = [], 0
+    for bid in range(n_blocks):
+        if resume and block_complete(outdir, shard_block_name(wid, bid),
+                                     entries):
+            resumed += 1
+        else:
+            missing.append(bid)
+    if resumed:
+        M_BLOCKS_RESUMED.inc(resumed)
+        log.info("worker %d build resume: %d/%d block(s) already "
+                 "complete and digest-valid", wid, resumed, n_blocks)
     if not missing:
         return []
     kind, structure = pick_build_kernel(graph, method)
@@ -331,9 +458,21 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         # compute-bound instead of drain-bound on a slow one.
         parts = [fetch_fm(d, count_dev=cd) for d, cd in devs]
         trimmed = [p[:ln] for p, ln in zip(parts, lens)]
-        np.save(os.path.join(outdir, shard_block_name(wid, bid)),
-                trimmed[0] if len(trimmed) == 1
-                else np.concatenate(trimmed))
+        arr = (trimmed[0] if len(trimmed) == 1
+               else np.concatenate(trimmed))
+        fname = shard_block_name(wid, bid)
+        # atomic write, then the ledger line: a kill between the two
+        # leaves a complete un-journaled file (the legacy-parse resume
+        # path accepts it); a kill MID-write leaves only tmp debris
+        digest = atomic_save_npy(os.path.join(outdir, fname), arr)
+        ledger.record(fname, digest, arr.shape, str(arr.dtype))
+        # chaos hook: DOS_FAULTS="crash-build;..." dies here, between
+        # block flushes — the kill-mid-build resume test's trigger
+        rule = faults.inject("crash-build", wid=wid)
+        if rule is not None:
+            if rule.mode == "exit":
+                os._exit(faults.KILL_EXIT_CODE)
+            raise RuntimeError("crash-build fault injected")
 
     def compute_with_count(tgts: np.ndarray):
         d = compute_dev(tgts)
@@ -357,11 +496,37 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     return written
 
 
+def _block_meta_for(outdir: str, fname: str,
+                    ledgers: dict[int, dict]) -> dict:
+    """Digest/shape/dtype for one block file, cheapest source first:
+    the worker's build ledger (digest already computed from the written
+    bytes), else read the file once."""
+    wid = int(fname.split("-")[1][1:])
+    if wid not in ledgers:
+        ledgers[wid] = BuildLedger(outdir, wid).entries()
+    ent = ledgers[wid].get(fname)
+    if ent is not None and "digest" in ent:
+        return {"digest": ent["digest"], "shape": list(ent["shape"]),
+                "dtype": ent["dtype"]}
+    path = os.path.join(outdir, fname)
+    arr = np.load(path, mmap_mode="r")
+    return {"digest": digest_file(path), "shape": list(arr.shape),
+            "dtype": str(arr.dtype)}
+
+
 def write_index_manifest(outdir: str, dc: DistributionController,
                          rows_per_worker: int | None = None,
-                         workers=None) -> dict:
+                         workers=None, block_meta: dict | None = None,
+                         ) -> dict:
     """Write ``index.json`` describing a per-block CPD index (the head
-    runs this after all workers' builds finish).
+    runs this after all workers' builds finish). Written atomically.
+
+    v2 manifests record per-block content digests, shapes, and dtypes
+    under ``blocks`` (``digest_algo`` names the checksum), so every
+    later load/verify can tell a valid block from a torn or rotted one.
+    ``block_meta`` optionally supplies those entries (digests computed
+    at write time); anything missing is harvested from the per-worker
+    build ledgers, and only as a last resort read back from disk.
 
     ``workers``: optional subset of worker ids to enumerate — a PARTIAL
     index for single-worker serving (the analog of the reference's ``-w``
@@ -379,8 +544,15 @@ def write_index_manifest(outdir: str, dc: DistributionController,
                     f"index incomplete: missing {fname} "
                     f"(worker {wid} block {bid})")
             files.append(fname)
+    ledgers: dict[int, dict] = {}
+    blocks = {}
+    for fname in files:
+        meta = (block_meta or {}).get(fname)
+        blocks[fname] = meta if meta is not None else _block_meta_for(
+            outdir, fname, ledgers)
     manifest = {
         "version": INDEX_VERSION,
+        "digest_algo": "crc32",
         "nodenum": dc.nodenum,
         "maxworker": dc.maxworker,
         "partmethod": dc.partmethod,
@@ -390,9 +562,9 @@ def write_index_manifest(outdir: str, dc: DistributionController,
         "rows_per_worker": (rows_per_worker if rows_per_worker is not None
                             else max(dc.max_owned, 1)),
         "files": files,
+        "blocks": blocks,
     }
-    with open(os.path.join(outdir, "index.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
+    atomic_write_json(os.path.join(outdir, "index.json"), manifest)
     return manifest
 
 
@@ -400,7 +572,14 @@ def validate_manifest(manifest: dict, dc: DistributionController,
                       outdir: str) -> None:
     """Check a loaded ``index.json`` against the serving controller (the
     reference keeps build and serve consistent by passing the same
-    partmethod/partkey quadruple everywhere; we verify it)."""
+    partmethod/partkey quadruple everywhere; we verify it).
+
+    Schema compatibility is the wire codecs' contract: unknown keys are
+    tolerated (a v1 index loads under v2 code, and a v2 index's digest
+    keys are invisible to v1-era fields), and only a manifest whose
+    version is NEWER than this code rejects — those may have changed
+    the meaning of keys we would silently misread."""
+    check_manifest_version(manifest, outdir)
     my_partkey = (list(dc.partkey)
                   if isinstance(dc.partkey, (list, tuple)) else dc.partkey)
     for key, mine in (("nodenum", dc.nodenum),
@@ -408,10 +587,187 @@ def validate_manifest(manifest: dict, dc: DistributionController,
                       ("partmethod", dc.partmethod),
                       ("partkey", my_partkey),
                       ("block_size", dc.block_size)):
+        if key not in manifest:
+            raise ValueError(
+                f"index {outdir} manifest is missing required key "
+                f"{key!r}")
         if manifest[key] != mine:
             raise ValueError(
                 f"index {outdir} was built with {key}={manifest[key]}, "
                 f"controller has {mine}")
+
+
+def check_manifest_version(manifest: dict, outdir: str) -> None:
+    """The version half of :func:`validate_manifest`, callable on its
+    own by load paths that have no controller to cross-check (the
+    engine's ``load_shard_rows``): a manifest NEWER than this code may
+    have changed the meaning of keys we would silently misread — reject
+    it outright instead of mis-verifying every block."""
+    version = int(manifest.get("version", 1))
+    if version > INDEX_VERSION:
+        raise ValueError(
+            f"index {outdir} has manifest schema v{version}; this build "
+            f"reads up to v{INDEX_VERSION} — upgrade the serving code "
+            "(unknown keys are tolerated, newer major versions are not)")
+
+
+def _verify_block(path: str, meta: dict | None, want_rows: bool):
+    """One block's verification against its manifest entry — the single
+    implementation behind :func:`check_block` (verify-only: streamed
+    digest + mmap'd header, no row materialization) and
+    :func:`load_verified_block` (one file read: digest over the bytes
+    in memory, then parse those same bytes). Returns
+    ``(rows | None, status, reason)`` with status one of ``ok``
+    (digest-verified), ``unverified`` (parses, but no digest to check —
+    v1 manifest), ``missing``, ``corrupt``."""
+    if not os.path.exists(path):
+        return None, "missing", "file absent"
+    need_digest = bool(meta and meta.get("digest"))
+    try:
+        if want_rows:
+            with open(path, "rb") as f:
+                data = f.read()
+            got = digest_bytes(data) if need_digest else None
+            arr = np.load(io.BytesIO(data))
+        else:
+            got = digest_file(path) if need_digest else None
+            arr = np.load(path, mmap_mode="r")
+        if need_digest and got != meta["digest"]:
+            return None, "corrupt", (f"digest {got} != manifest "
+                                     f"{meta['digest']}")
+        if meta:
+            if ("shape" in meta
+                    and list(arr.shape) != list(meta["shape"])):
+                return None, "corrupt", (
+                    f"shape {list(arr.shape)} != manifest "
+                    f"{list(meta['shape'])}")
+            if "dtype" in meta and str(arr.dtype) != meta["dtype"]:
+                return None, "corrupt", (f"dtype {arr.dtype} != "
+                                         f"manifest {meta['dtype']}")
+    except Exception as e:  # noqa: BLE001 — torn header, short file, ...
+        return None, "corrupt", f"unreadable: {type(e).__name__}: {e}"
+    return (arr if want_rows else None,
+            "ok" if need_digest else "unverified", "")
+
+
+def check_block(path: str, meta: dict | None) -> tuple[str, str]:
+    """Verify one block file WITHOUT materializing the rows (streamed
+    digest, mmap'd header); returns ``(status, reason)``."""
+    _, status, reason = _verify_block(path, meta, want_rows=False)
+    return status, reason
+
+
+def load_verified_block(path: str, meta: dict | None):
+    """Load one block's rows with verification in a SINGLE file read;
+    returns ``(rows | None, status, reason)`` — rows is None whenever
+    status is ``missing``/``corrupt``."""
+    return _verify_block(path, meta, want_rows=True)
+
+
+def heal_block(outdir: str, manifest: dict | None, fname: str, wid: int,
+               graph: Graph, dc: DistributionController,
+               status: str = "corrupt", reason: str = "") -> np.ndarray:
+    """The shared self-heal sequence of both load paths
+    (``CPDOracle.load`` and the engine's ``load_shard_rows``):
+    quarantine the bad block, rebuild it in place from the graph
+    (``build_worker_shard`` with resume recomputes exactly the blocks
+    whose ledger/digest check fails — here, only the quarantined one),
+    reload, and refresh the manifest entry when the rebuilt digest
+    differs from the recorded one — otherwise every later load would
+    re-flag the healthy rebuild as corrupt and rebuild it again.
+    Returns the rebuilt rows; raises ``ValueError`` when the rebuild
+    itself cannot produce a loadable block."""
+    path = os.path.join(outdir, fname)
+    qpath = quarantine(path)
+    log.warning("CPD block %s is %s (%s); %srebuilding from the graph",
+                fname, status, reason,
+                f"quarantined to {qpath}; " if qpath else "")
+    with obs_trace.span("cpd.rebuild", file=fname, wid=wid):
+        build_worker_shard(graph, dc, wid, outdir)
+    rows, _status2, reason2 = load_verified_block(path, None)
+    if rows is None:
+        raise ValueError(
+            f"CPD block {fname} in {outdir} could not be rebuilt: "
+            f"{reason2} (original fault: {reason})")
+    M_BLOCKS_REBUILT.inc()
+    meta = (manifest or {}).get("blocks", {}).get(fname)
+    new_digest = digest_file(path)
+    if meta is not None and meta.get("digest") != new_digest:
+        if meta.get("digest"):
+            log.warning(
+                "rebuilt %s has digest %s != manifest %s (different "
+                "build kernel?); refreshing the manifest entry",
+                fname, new_digest, meta["digest"])
+        manifest["blocks"][fname] = {"digest": new_digest,
+                                     "shape": list(rows.shape),
+                                     "dtype": str(rows.dtype)}
+        atomic_write_json(os.path.join(outdir, "index.json"), manifest)
+    return rows
+
+
+def read_manifest(outdir: str) -> dict:
+    with open(os.path.join(outdir, "index.json")) as f:
+        return json.load(f)
+
+
+def verify_index(outdir: str, dc: DistributionController | None = None,
+                 manifest: dict | None = None) -> dict:
+    """Check-only integrity pass over a CPD index: every manifest block
+    is digest/shape-verified in place (``make_cpds --verify``, and the
+    bench's post-build gate). Returns a report dict::
+
+        {"total": N, "ok": n, "unverified": [...],   # no digest (v1)
+         "missing": [...], "corrupt": [{"file","reason"}, ...],
+         "fatal": "..."}                              # manifest-level
+
+    ``dc`` additionally cross-checks the partition quadruple. Mapped to
+    exit codes by :func:`verify_exit_code` (0/3/4 clean/degraded/
+    corrupt, the campaign driver's convention)."""
+    report: dict = {"total": 0, "ok": 0, "unverified": [],
+                    "missing": [], "corrupt": []}
+    if manifest is None:
+        try:
+            manifest = read_manifest(outdir)
+        except (OSError, ValueError) as e:
+            report["fatal"] = f"no readable manifest in {outdir}: {e}"
+            return report
+    if dc is not None:
+        try:
+            validate_manifest(manifest, dc, outdir)
+        except ValueError as e:
+            report["fatal"] = str(e)
+            return report
+    blocks_meta = manifest.get("blocks", {})
+    report["total"] = len(manifest.get("files", []))
+    for fname in manifest.get("files", []):
+        with obs_trace.span("cpd.verify", file=fname):
+            status, reason = check_block(os.path.join(outdir, fname),
+                                         blocks_meta.get(fname))
+        if status == "ok":
+            M_BLOCKS_VERIFIED.inc()
+            report["ok"] += 1
+        elif status == "unverified":
+            report["unverified"].append(fname)
+        elif status == "missing":
+            M_BLOCKS_CORRUPT.inc()
+            report["missing"].append(fname)
+        else:
+            M_BLOCKS_CORRUPT.inc()
+            report["corrupt"].append({"file": fname, "reason": reason})
+    return report
+
+
+def verify_exit_code(report: dict) -> int:
+    """0 clean (every block ok or legacy-unverified), 3 degraded (some
+    blocks bad), 4 corrupt (manifest unreadable/mismatched, or no block
+    survived) — mirroring ``process_query``'s 0/3/4 convention."""
+    if report.get("fatal"):
+        return 4
+    bad = len(report["missing"]) + len(report["corrupt"])
+    if bad == 0:
+        return 0
+    good = report["ok"] + len(report["unverified"])
+    return 3 if good > 0 else 4
 
 
 class CPDOracle:
@@ -482,6 +838,7 @@ class CPDOracle:
         if primary:
             os.makedirs(outdir, exist_ok=True)
         bs = self.dc.block_size
+        block_meta: dict[str, dict] = {}
         for wid in range(self.dc.maxworker):
             n_owned = self.dc.n_owned(wid)
             # ONE fetch per worker: bounded host memory (1/W of the
@@ -491,23 +848,39 @@ class CPDOracle:
             rows_w = _host(self.fm[wid, :n_owned])
             if primary:
                 for b0 in range(0, n_owned, bs):
-                    np.save(
-                        os.path.join(outdir,
-                                     shard_block_name(wid, b0 // bs)),
+                    fname = shard_block_name(wid, b0 // bs)
+                    arr = np.ascontiguousarray(
                         rows_w[b0:min(b0 + bs, n_owned)])
+                    digest = atomic_save_npy(
+                        os.path.join(outdir, fname), arr)
+                    block_meta[fname] = {"digest": digest,
+                                         "shape": list(arr.shape),
+                                         "dtype": str(arr.dtype)}
             del rows_w
         if primary:
             write_index_manifest(
                 outdir, self.dc,
-                rows_per_worker=int(self.targets_wr.shape[1]))
+                rows_per_worker=int(self.targets_wr.shape[1]),
+                block_meta=block_meta)
 
-    def load(self, outdir: str) -> "CPDOracle":
-        """Load a saved index onto the mesh, validating partition consistency
-        (the reference keeps build and serve consistent by passing the same
-        partmethod/partkey quadruple everywhere; we verify it)."""
-        with open(os.path.join(outdir, "index.json")) as f:
-            manifest = json.load(f)
+    def load(self, outdir: str, heal: bool = True) -> "CPDOracle":
+        """Load a saved index onto the mesh, validating partition
+        consistency (the reference keeps build and serve consistent by
+        passing the same partmethod/partkey quadruple everywhere; we
+        verify it) AND per-block content: every block is digest/shape
+        checked as it loads (v2 manifests), so a torn write or bit-rot
+        fails here with a per-block diagnostic instead of poisoning
+        queries.
+
+        ``heal=True`` (default): a missing/corrupt block is quarantined
+        (``<file>.quarantined``) and rebuilt in place from the graph —
+        the oracle always has it resident — then re-verified; the
+        manifest entry is refreshed if the rebuilt digest differs (e.g.
+        the original index predates the current kernel selection).
+        ``heal=False`` raises on the first bad block instead."""
+        manifest = read_manifest(outdir)
         validate_manifest(manifest, self.dc, outdir)
+        blocks_meta = manifest.get("blocks", {})
         w = self.dc.maxworker
         r = self.targets_wr.shape[1]
         fm = np.full((w, r, self.graph.n), -1, np.int8)
@@ -516,7 +889,23 @@ class CPDOracle:
             stem = fname[:-len(".npy")]
             _, wpart, bpart = stem.split("-")
             wid, bid = int(wpart[1:]), int(bpart[1:])
-            rows = np.load(os.path.join(outdir, fname))
+            path = os.path.join(outdir, fname)
+            meta = blocks_meta.get(fname)
+            with obs_trace.span("cpd.verify", file=fname):
+                rows, status, reason = load_verified_block(path, meta)
+            if rows is None:
+                M_BLOCKS_CORRUPT.inc()
+                if not heal:
+                    raise ValueError(
+                        f"CPD block {fname} in {outdir} is {status}: "
+                        f"{reason}")
+                rows = heal_block(outdir, manifest, fname, wid,
+                                  self.graph, self.dc,
+                                  status=status, reason=reason)
+            elif status == "ok":
+                # only digest-checked blocks count as verified; v1
+                # (digest-less) blocks load fine but stay unverified
+                M_BLOCKS_VERIFIED.inc()
             fm[wid, bid * bs: bid * bs + len(rows)] = rows
         self.fm = jax.device_put(fm, worker_sharding(self.mesh, rank=3))
         return self

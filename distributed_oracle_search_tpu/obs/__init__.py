@@ -79,6 +79,23 @@ admission decision, batch, and cache outcome is visible):
   ``serve_cache_{entries,bytes}`` gauges;
 * worker-side dedup (the batch-level twin of the cache) —
   ``worker_duplicate_queries_total``.
+
+Artifact durability layer (the index data plane — atomic writes,
+checksummed manifests, crash-resume, self-healing loads; see the
+README's "Artifact durability & resume"):
+
+* load/verify — ``cpd_blocks_verified_total`` (blocks that passed the
+  digest/shape check), ``cpd_blocks_corrupt_total`` (missing, torn, or
+  digest-mismatched blocks found at load or ``make_cpds --verify``),
+  ``cpd_blocks_rebuilt_total`` (quarantined blocks rebuilt in place
+  from the graph); ``cpd.verify`` / ``cpd.rebuild`` spans carry the
+  per-block timings;
+* crash-resume — ``build_blocks_resumed_total`` (blocks a restarted
+  build skipped because the per-worker ledger records them complete
+  with a matching on-disk digest);
+* sweep — ``artifacts_swept_total`` (stale ``*.tmp`` debris and
+  leftover ``*.quarantined`` blocks removed at build/campaign start,
+  the artifact-plane analog of ``head_stale_fifos_cleaned_total``).
 """
 
 from . import metrics, trace

@@ -21,6 +21,11 @@ Points (enacted by the call sites, see the table in the README's
                      replying (``mode=exit`` → ``os._exit(86)``, the
                      real-crash default; ``mode=raise`` → the serve loop
                      returns, for in-thread test servers)
+* ``crash-build``    the CPD builder dies between block flushes — after
+                     a block's atomic write + ledger line, before the
+                     next block starts (``mode=exit`` → ``os._exit(86)``
+                     default; ``mode=raise`` → RuntimeError). The
+                     kill-mid-build resume test's trigger.
 
 Rule keys: ``wid`` restricts to one worker id, ``after`` skips the first
 N eligible events, ``times`` caps fires (``inf`` = always), ``delay`` and
@@ -51,7 +56,7 @@ log = get_logger(__name__)
 KILL_EXIT_CODE = 86
 
 POINTS = ("drop-reply", "delay", "crash-engine", "corrupt-frame",
-          "kill-mid-batch")
+          "kill-mid-batch", "crash-build")
 
 M_INJECTED = obs_metrics.counter(
     "faults_injected_total", "fault-harness rules fired (DOS_FAULTS)")

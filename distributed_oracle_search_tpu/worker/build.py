@@ -42,14 +42,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference README.md:93)")
     p.add_argument("--chunk", type=int, default=0,
                    help="build-step rows (0 = whole shard at once)")
+    p.add_argument("--block-size", type=int, default=0,
+                   help="rows per block FILE (0 = the controller "
+                        "default, which is what the serving CLIs "
+                        "expect; the manifest records the value and "
+                        "make_cpds --verify honors it — non-default "
+                        "sizes are for tooling/chaos tests whose "
+                        "consumers build a matching controller)")
     p.add_argument("--method", default="auto",
-                   choices=["auto", "sweep", "shift", "ellsplit", "ell"],
+                   choices=["auto", "sweep", "shift", "frontier",
+                            "ellsplit", "ell"],
                    help="relaxation kernel: fast-sweeping grid scans, "
-                        "gather-free shift path, ELL+COO split (degree-"
-                        "skewed graphs), padded-ELL gather, or auto by "
-                        "structure gates (models.cpd.pick_build_kernel)")
+                        "gather-free shift path, delta-stepping frontier "
+                        "queue, ELL+COO split (degree-skewed graphs), "
+                        "padded-ELL gather, or auto by structure gates "
+                        "(models.cpd.pick_build_kernel)")
     p.add_argument("--no-resume", action="store_true",
-                   help="rebuild blocks even if their files exist")
+                   help="rebuild every block from scratch (default: "
+                        "resume — skip blocks the build ledger records "
+                        "as complete with a matching on-disk digest)")
+    p.add_argument("--metrics-dump", default="",
+                   help="write a JSON obs-metrics snapshot here on exit "
+                        "(build_blocks_resumed_total etc.)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -61,8 +75,10 @@ def main(argv=None) -> int:
     partkey = args.partkey if args.partmethod == "alloc" else args.partkey[0]
 
     graph = Graph.from_xy(args.input)
+    dc_kw = ({"block_size": args.block_size} if args.block_size > 0
+             else {})
     dc = DistributionController(args.partmethod, partkey, args.maxworker,
-                                graph.n)
+                                graph.n, **dc_kw)
     written = build_worker_shard(graph, dc, args.workerid, outdir,
                                  chunk=args.chunk,
                                  resume=not args.no_resume,
@@ -70,6 +86,10 @@ def main(argv=None) -> int:
     log.info("worker %d: wrote %d block(s) to %s",
              args.workerid, len(written), outdir)
     print(f"worker {args.workerid}: {len(written)} block(s) -> {outdir}")
+    if args.metrics_dump:
+        from ..obs import metrics as obs_metrics
+
+        obs_metrics.REGISTRY.dump_json(args.metrics_dump)
     return 0
 
 

@@ -61,16 +61,66 @@ M_DUPS = obs_metrics.counter(
     "batch — the kernel only runs each distinct pair once")
 
 
-def load_shard_rows(outdir: str, wid: int) -> np.ndarray:
+def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
+                    heal: bool = True) -> np.ndarray:
     """Load one worker's CPD rows from the block files the builder wrote
     (``cpd-w<wid>-b<bid>.npy``; the index manifest is optional so a shard
-    can serve before the whole cluster's build completes)."""
+    can serve before the whole cluster's build completes).
+
+    When the manifest is present its per-block digests are verified as
+    the rows load; a corrupt/torn block is quarantined and — when the
+    caller supplies ``graph`` and ``dc`` (``ShardEngine`` does) —
+    rebuilt in place, else the load fails with the per-block diagnostic
+    instead of serving garbage answers."""
+    from ..models.cpd import (
+        M_BLOCKS_CORRUPT, M_BLOCKS_VERIFIED, check_manifest_version,
+        heal_block, load_verified_block, read_manifest,
+    )
+
+    manifest: dict | None = None
+    try:
+        manifest = read_manifest(outdir)
+    except (OSError, ValueError):
+        pass                       # pre-manifest partial build: no digests
+    if manifest is not None:
+        # same schema gate as CPDOracle.load: a NEWER manifest's digest
+        # entries must not be misread into mass quarantine/rebuild
+        check_manifest_version(manifest, outdir)
+    blocks_meta = (manifest or {}).get("blocks", {})
     pat = os.path.join(outdir, f"cpd-w{wid:05d}-b*.npy")
     files = sorted(glob.glob(pat),
                    key=lambda p: int(re.search(r"-b(\d+)\.npy$", p).group(1)))
+    # the manifest knows blocks the glob cannot see (deleted on disk)
+    manifested = sorted(
+        (os.path.join(outdir, f) for f in blocks_meta
+         if f.startswith(f"cpd-w{wid:05d}-")),
+        key=lambda p: int(re.search(r"-b(\d+)\.npy$", p).group(1)))
+    files = manifested if manifested else files
     if not files:
         raise FileNotFoundError(f"no CPD blocks for worker {wid} in {outdir}")
-    return np.concatenate([np.load(f) for f in files], axis=0)
+    parts = []
+    for path in files:
+        fname = os.path.basename(path)
+        with obs_trace.span("cpd.verify", file=fname, wid=wid):
+            rows, status, reason = load_verified_block(
+                path, blocks_meta.get(fname))
+        if rows is None:
+            M_BLOCKS_CORRUPT.inc()
+            if not heal or graph is None or dc is None:
+                raise ValueError(
+                    f"CPD block {fname} in {outdir} is {status}: {reason}"
+                    + ("" if heal else " (healing disabled)")
+                    + ("" if graph is not None and dc is not None
+                       else " — no graph/controller to rebuild from; "
+                            "load degraded"))
+            rows = heal_block(outdir, manifest, fname, wid, graph, dc,
+                              status=status, reason=reason)
+        elif status == "ok":
+            # only digest-checked blocks count as verified (same rule
+            # as CPDOracle.load)
+            M_BLOCKS_VERIFIED.inc()
+        parts.append(rows)
+    return np.concatenate(parts, axis=0)
 
 
 class ShardEngine:
@@ -89,7 +139,8 @@ class ShardEngine:
         #: between chunks (first chunk always runs)
         self.astar_chunk = 1024
         if alg == "table-search":  # astar needs no first-move shard
-            self.fm = jnp.asarray(load_shard_rows(outdir, wid))
+            self.fm = jnp.asarray(load_shard_rows(outdir, wid, dc=dc,
+                                                  graph=graph))
             owned = dc.owned(wid)
             if len(owned) != self.fm.shape[0]:
                 raise ValueError(

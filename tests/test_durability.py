@@ -1,0 +1,472 @@
+"""Durable CPD builds: atomic artifacts, checksummed manifests,
+crash-resume, and self-healing loads.
+
+Non-slow: atomic-write/sweep units, manifest v2 digest round-trips, the
+schema compat contract (unknown keys tolerated, v1 loads under v2 code,
+newer majors rejected — the RuntimeConfig wire-codec rule applied to the
+index manifest), corrupt-block detection + quarantine + in-place rebuild
+at load (oracle and engine paths, counters asserted), verify exit codes,
+and ``dos-serve`` draining cleanly on SIGTERM.
+
+Slow: the kill-mid-build chaos drill — the build SUBPROCESS dies between
+block flushes via the ``crash-build`` fault point, the rerun resumes off
+the digest-verified ledger, and the completed index is bit-identical to
+an uninterrupted build while only the missing tail was recomputed.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import (
+    synth_city_graph, write_xy,
+)
+from distributed_oracle_search_tpu.models.cpd import (
+    CPDOracle, INDEX_VERSION, M_BLOCKS_CORRUPT, M_BLOCKS_REBUILT,
+    M_BLOCKS_RESUMED, M_BLOCKS_VERIFIED, BuildLedger, block_complete,
+    build_worker_shard, ledger_path, read_manifest, shard_block_name,
+    validate_manifest, verify_exit_code, verify_index,
+    write_index_manifest,
+)
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.utils import atomicio
+from distributed_oracle_search_tpu.worker.engine import (
+    ShardEngine, load_shard_rows,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_WORKERS = 8
+BLOCK_SIZE = 4          # several blocks per worker on the toy graph
+
+
+@pytest.fixture()
+def toy_dc(toy_graph):
+    return DistributionController("tpu", N_WORKERS, N_WORKERS,
+                                  toy_graph.n, block_size=BLOCK_SIZE)
+
+
+@pytest.fixture()
+def built_dir(tmp_path, toy_graph, toy_dc):
+    """A complete per-block index with a v2 manifest."""
+    d = str(tmp_path / "index")
+    for wid in range(N_WORKERS):
+        build_worker_shard(toy_graph, toy_dc, wid, d)
+    write_index_manifest(d, toy_dc)
+    return d
+
+
+def _corrupt(path: str, flip_at: int = -3) -> None:
+    raw = bytearray(open(path, "rb").read())
+    raw[flip_at] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+
+
+# ------------------------------------------------------------- atomic IO
+
+def test_atomic_write_bytes_roundtrip(tmp_path):
+    p = str(tmp_path / "a.bin")
+    atomicio.atomic_write_bytes(p, b"payload")
+    assert open(p, "rb").read() == b"payload"
+    # no tmp debris after a successful write
+    assert not glob.glob(str(tmp_path / "*.tmp.*"))
+
+
+def test_digest_is_algorithm_prefixed_and_stable(tmp_path):
+    d1 = atomicio.digest_bytes(b"abc")
+    assert d1.startswith("crc32:")
+    p = str(tmp_path / "f")
+    atomicio.atomic_write_bytes(p, b"abc")
+    assert atomicio.digest_file(p) == d1
+
+
+def test_sweep_removes_tmp_and_quarantine_debris(tmp_path):
+    import time
+
+    old = time.time() - 3600         # debris from a long-dead process
+    for name in ("cpd-w00000-b00000.npy.tmp.123",
+                 "cpd-w00000-b00001.npy.quarantined"):
+        p = tmp_path / name
+        p.write_bytes(b"stale")
+        os.utime(p, (old, old))
+    (tmp_path / "cpd-w00000-b00002.npy").write_bytes(b"keep")
+    # a FRESH tmp file may be another live process's in-flight atomic
+    # write (a resident server mid-heal) — the sweep must leave it alone
+    (tmp_path / "cpd-w00000-b00003.npy.tmp.456").write_bytes(b"live")
+    before = obs_metrics.REGISTRY.snapshot()["counters"].get(
+        "artifacts_swept_total", 0)
+    n = atomicio.sweep_stale_artifacts(str(tmp_path))
+    after = obs_metrics.REGISTRY.snapshot()["counters"].get(
+        "artifacts_swept_total", 0)
+    assert n == 2 and after - before == 2
+    assert sorted(os.listdir(tmp_path)) == [
+        "cpd-w00000-b00002.npy", "cpd-w00000-b00003.npy.tmp.456"]
+
+
+def test_atomic_npy_digest_matches_file(tmp_path):
+    arr = np.arange(24, dtype=np.int8).reshape(4, 6)
+    p = str(tmp_path / "b.npy")
+    digest = atomicio.atomic_save_npy(p, arr)
+    assert digest == atomicio.digest_file(p)
+    assert (np.load(p) == arr).all()
+
+
+# ------------------------------------------------- ledger + crash-resume
+
+def test_ledger_records_and_verifies_blocks(tmp_path, toy_graph, toy_dc):
+    d = str(tmp_path / "idx")
+    build_worker_shard(toy_graph, toy_dc, 0, d)
+    entries = BuildLedger(d, 0).entries()
+    n_blocks = -(-toy_dc.n_owned(0) // BLOCK_SIZE)
+    assert len(entries) == n_blocks
+    fname = shard_block_name(0, 0)
+    assert block_complete(d, fname, entries)
+    # digest mismatch -> not complete -> the block would be recomputed
+    _corrupt(os.path.join(d, fname))
+    assert not block_complete(d, fname, entries)
+
+
+def test_ledger_tolerates_torn_trailing_line(tmp_path, toy_graph, toy_dc):
+    d = str(tmp_path / "idx")
+    build_worker_shard(toy_graph, toy_dc, 0, d)
+    with open(ledger_path(d, 0), "a") as f:
+        f.write('{"file": "cpd-w00000-b9')     # crash mid-append
+    entries = BuildLedger(d, 0).entries()
+    assert shard_block_name(0, 0) in entries   # earlier lines intact
+
+
+def test_resume_recomputes_only_invalid_blocks(tmp_path, toy_graph,
+                                               toy_dc):
+    d = str(tmp_path / "idx")
+    ref = str(tmp_path / "ref")
+    build_worker_shard(toy_graph, toy_dc, 0, ref)
+    build_worker_shard(toy_graph, toy_dc, 0, d)
+    # one block deleted, one corrupted: resume must redo exactly those
+    gone = shard_block_name(0, 0)
+    bad = shard_block_name(0, 1)
+    os.remove(os.path.join(d, gone))
+    _corrupt(os.path.join(d, bad))
+    r0 = M_BLOCKS_RESUMED.value
+    written = build_worker_shard(toy_graph, toy_dc, 0, d)
+    assert sorted(written) == sorted([gone, bad])
+    n_blocks = -(-toy_dc.n_owned(0) // BLOCK_SIZE)
+    assert M_BLOCKS_RESUMED.value - r0 == n_blocks - 2
+    for f in sorted(os.listdir(ref)):
+        if f.endswith(".npy"):
+            assert (open(os.path.join(d, f), "rb").read()
+                    == open(os.path.join(ref, f), "rb").read()), f
+
+
+def test_legacy_unledgered_blocks_resume(tmp_path, toy_graph, toy_dc):
+    """Blocks from a pre-ledger build (no journal) still count as done
+    when they parse; torn ones are rebuilt."""
+    d = str(tmp_path / "idx")
+    build_worker_shard(toy_graph, toy_dc, 0, d)
+    os.remove(ledger_path(d, 0))
+    assert build_worker_shard(toy_graph, toy_dc, 0, d) == []
+    # truncate one block: unreadable npy -> recomputed
+    bad = os.path.join(d, shard_block_name(0, 1))
+    with open(bad, "wb") as f:
+        f.write(b"\x93NUMPY")                  # torn header
+    written = build_worker_shard(toy_graph, toy_dc, 0, d)
+    assert written == [shard_block_name(0, 1)]
+
+
+def test_build_sweeps_own_tmp_debris(tmp_path, toy_graph, toy_dc):
+    import time
+
+    d = str(tmp_path / "idx")
+    os.makedirs(d)
+    debris = os.path.join(d, shard_block_name(0, 0) + ".tmp.999")
+    fresh = os.path.join(d, shard_block_name(0, 1) + ".tmp.888")
+    other = os.path.join(d, shard_block_name(3, 0) + ".tmp.999")
+    for p in (debris, fresh, other):
+        with open(p, "wb") as f:
+            f.write(b"torn")
+    old = time.time() - 3600
+    for p in (debris, other):
+        os.utime(p, (old, old))
+    build_worker_shard(toy_graph, toy_dc, 0, d)
+    assert not os.path.exists(debris)    # mine + stale: swept
+    assert os.path.exists(fresh)         # mine but YOUNG (possibly a
+    #                                      live concurrent write): kept
+    assert os.path.exists(other)         # another worker's: kept
+
+
+# --------------------------------------------- manifest v2 + compat
+
+def test_manifest_v2_records_digests(built_dir, toy_dc):
+    m = read_manifest(built_dir)
+    assert m["version"] == INDEX_VERSION == 2
+    assert m["digest_algo"] == "crc32"
+    assert set(m["blocks"]) == set(m["files"])
+    ent = m["blocks"][m["files"][0]]
+    assert ent["digest"].startswith("crc32:")
+    assert ent["dtype"] == "int8" and len(ent["shape"]) == 2
+
+
+def test_validate_manifest_compat_contract(built_dir, toy_dc):
+    """The wire-codec rule applied to the manifest: unknown keys are
+    tolerated, only a NEWER schema version rejects. A manifest missing
+    a REQUIRED key raises ValueError (not KeyError), so verify_index
+    books it fatal instead of crashing the --verify CLI. The engine
+    load path applies the same version gate — a v3 manifest must not
+    be misread into mass quarantine/rebuild."""
+    m = read_manifest(built_dir)
+    m["some_future_key"] = {"nested": True}
+    validate_manifest(m, toy_dc, built_dir)            # no raise
+    m2 = dict(m)
+    del m2["nodenum"]
+    with pytest.raises(ValueError, match="missing required key"):
+        validate_manifest(m2, toy_dc, built_dir)
+    m["version"] = INDEX_VERSION + 1
+    with pytest.raises(ValueError, match="schema"):
+        validate_manifest(m, toy_dc, built_dir)
+    with open(os.path.join(built_dir, "index.json"), "w") as f:
+        json.dump(m, f)
+    with pytest.raises(ValueError, match="schema"):
+        load_shard_rows(built_dir, 0)
+    assert verify_exit_code(verify_index(built_dir, dc=toy_dc)) == 4
+
+
+def test_v1_manifest_loads_under_v2_code(built_dir, toy_graph, toy_dc):
+    """A pre-digest index keeps loading: v1 has no ``blocks``, so the
+    load runs in unverified mode and still answers correctly."""
+    m = read_manifest(built_dir)
+    m.pop("blocks")
+    m.pop("digest_algo")
+    m["version"] = 1
+    with open(os.path.join(built_dir, "index.json"), "w") as f:
+        json.dump(m, f)
+    oracle = CPDOracle(toy_graph, toy_dc).load(built_dir)
+    queries = np.array([[1, 5], [7, 40], [3, 3]])
+    cost, plen, fin = oracle.query(queries)
+    assert bool(fin.all())
+    rep = verify_index(built_dir, dc=toy_dc)
+    assert verify_exit_code(rep) == 0          # unverified counts clean
+    assert len(rep["unverified"]) == rep["total"]
+
+
+# ------------------------------------- corrupt blocks: detect/quarantine
+
+def test_load_detects_quarantines_and_rebuilds(built_dir, toy_graph,
+                                               toy_dc):
+    fname = shard_block_name(2, 1)
+    path = os.path.join(built_dir, fname)
+    _corrupt(path)
+    v0, c0, r0 = (M_BLOCKS_VERIFIED.value, M_BLOCKS_CORRUPT.value,
+                  M_BLOCKS_REBUILT.value)
+    oracle = CPDOracle(toy_graph, toy_dc).load(built_dir)
+    assert M_BLOCKS_CORRUPT.value - c0 == 1
+    assert M_BLOCKS_REBUILT.value - r0 == 1
+    assert M_BLOCKS_VERIFIED.value - v0 == len(
+        read_manifest(built_dir)["files"]) - 1
+    assert os.path.exists(path + ".quarantined")
+    # healed in place: the index verifies clean again and answers match
+    # a freshly built oracle
+    assert verify_exit_code(verify_index(built_dir, dc=toy_dc)) == 0
+    ref = CPDOracle(toy_graph, toy_dc).build()
+    queries = np.stack(np.meshgrid(np.arange(0, toy_graph.n, 5),
+                                   np.arange(0, toy_graph.n, 7)),
+                       axis=-1).reshape(-1, 2)
+    got = oracle.query(queries)
+    want = ref.query(queries)
+    for a, b in zip(got, want):
+        assert (a == b).all()
+
+
+def test_load_without_heal_raises_diagnostic(built_dir, toy_graph,
+                                             toy_dc):
+    fname = shard_block_name(1, 0)
+    _corrupt(os.path.join(built_dir, fname))
+    with pytest.raises(ValueError, match=fname):
+        CPDOracle(toy_graph, toy_dc).load(built_dir, heal=False)
+
+
+def test_load_missing_block_is_rebuilt(built_dir, toy_graph, toy_dc):
+    """The manifest knows blocks the directory glob cannot see."""
+    fname = shard_block_name(4, 0)
+    os.remove(os.path.join(built_dir, fname))
+    r0 = M_BLOCKS_REBUILT.value
+    CPDOracle(toy_graph, toy_dc).load(built_dir)
+    assert M_BLOCKS_REBUILT.value - r0 == 1
+    assert os.path.exists(os.path.join(built_dir, fname))
+
+
+def test_engine_load_self_heals(built_dir, toy_graph, toy_dc):
+    fname = shard_block_name(3, 1)
+    path = os.path.join(built_dir, fname)
+    _corrupt(path)
+    c0, r0 = M_BLOCKS_CORRUPT.value, M_BLOCKS_REBUILT.value
+    eng = ShardEngine(toy_graph, toy_dc, 3, built_dir)
+    assert M_BLOCKS_CORRUPT.value - c0 == 1
+    assert M_BLOCKS_REBUILT.value - r0 == 1
+    assert os.path.exists(path + ".quarantined")
+    owned = toy_dc.owned(3)
+    queries = np.stack([np.arange(len(owned)), owned], axis=1)
+    from distributed_oracle_search_tpu.transport.wire import RuntimeConfig
+    cost, plen, fin, _stats = eng.answer(queries, RuntimeConfig())
+    assert bool(fin.all())
+
+
+def test_engine_heal_refreshes_manifest_no_rebuild_churn(
+        built_dir, toy_graph, toy_dc):
+    """A rebuilt block whose digest differs from the manifest (index
+    built by a different kernel) must refresh the manifest entry —
+    otherwise every later load re-flags the healthy rebuild as corrupt
+    and rebuilds it again, forever."""
+    fname = shard_block_name(6, 0)
+    m = read_manifest(built_dir)
+    m["blocks"][fname]["digest"] = "crc32:00000000"   # foreign build
+    with open(os.path.join(built_dir, "index.json"), "w") as f:
+        json.dump(m, f)
+    r0 = M_BLOCKS_REBUILT.value
+    load_shard_rows(built_dir, 6, dc=toy_dc, graph=toy_graph)
+    assert M_BLOCKS_REBUILT.value - r0 == 1
+    # manifest refreshed with the rebuilt digest: the next load (either
+    # path) finds the index clean — no rebuild churn
+    assert verify_exit_code(verify_index(built_dir, dc=toy_dc)) == 0
+    load_shard_rows(built_dir, 6, dc=toy_dc, graph=toy_graph)
+    CPDOracle(toy_graph, toy_dc).load(built_dir)
+    assert M_BLOCKS_REBUILT.value - r0 == 1
+
+
+def test_engine_load_degrades_without_graph(built_dir):
+    _corrupt(os.path.join(built_dir, shard_block_name(5, 0)))
+    with pytest.raises(ValueError, match="degraded"):
+        load_shard_rows(built_dir, 5)
+
+
+# ------------------------------------------------------ verify exit codes
+
+def test_verify_exit_codes(built_dir, toy_dc, tmp_path):
+    # clean
+    assert verify_exit_code(verify_index(built_dir, dc=toy_dc)) == 0
+    # degraded: one bad block among many
+    _corrupt(os.path.join(built_dir, shard_block_name(0, 0)))
+    rep = verify_index(built_dir, dc=toy_dc)
+    assert verify_exit_code(rep) == 3
+    assert rep["corrupt"][0]["file"] == shard_block_name(0, 0)
+    # corrupt: every block bad (a different byte than above, so the
+    # already-corrupt block stays corrupt instead of un-flipping)
+    for f in read_manifest(built_dir)["files"]:
+        _corrupt(os.path.join(built_dir, f), flip_at=-5)
+    assert verify_exit_code(verify_index(built_dir, dc=toy_dc)) == 4
+    # fatal: no manifest at all
+    rep = verify_index(str(tmp_path / "nowhere"))
+    assert rep["fatal"] and verify_exit_code(rep) == 4
+    # fatal: partition mismatch
+    other = DistributionController("tpu", N_WORKERS, N_WORKERS,
+                                   toy_dc.nodenum, block_size=64)
+    rep = verify_index(built_dir, dc=other)
+    assert rep["fatal"] and verify_exit_code(rep) == 4
+
+
+def test_make_cpds_verify_cli(tmp_path, monkeypatch):
+    """--verify exits 0/3 per the campaign convention, through main()."""
+    from distributed_oracle_search_tpu.cli.make_cpds import main as cpds
+    monkeypatch.chdir(tmp_path)
+    assert cpds(["-t"]) == 0
+    assert cpds(["-t", "--verify"]) == 0
+    blocks = sorted(glob.glob("data/index/cpd-*.npy"))
+    _corrupt(blocks[0])
+    code = cpds(["-t", "--verify"])
+    assert code == (3 if len(blocks) > 1 else 4)
+
+
+# --------------------------------------------------- dos-serve drain
+
+def test_serve_sigterm_drains_and_exits_clean(tmp_path):
+    """SIGTERM stops ingress, answers/sheds every accepted request,
+    writes the metrics dump, and exits 0 — never a silent drop."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_oracle_search_tpu.cli.serve",
+         "-t", "--ingress", "stdin", "--metrics-dump", "serve_obs.json"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        cwd=str(tmp_path), env=env)
+    try:
+        for q in ("1 5", "2 9", "7 40"):
+            proc.stdin.write(q + "\n")
+        proc.stdin.flush()
+        answers = [proc.stdout.readline().strip()]   # at least one served
+        assert answers[0].startswith("OK ")
+        proc.send_signal(signal.SIGTERM)
+        # every accepted request still gets a response line before exit
+        for line in proc.stdout:
+            if line.strip():
+                answers.append(line.strip())
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert rc == 0
+    assert len(answers) == 3
+    assert all(a.split()[0] in ("OK", "BUSY", "UNAVAILABLE", "TIMEOUT",
+                                "ERROR") for a in answers)
+    assert os.path.exists(tmp_path / "serve_obs.json")
+
+
+# -------------------------------------------------- chaos: kill-mid-build
+
+@pytest.mark.slow
+def test_kill_mid_build_resume_chaos(tmp_path, toy_graph):
+    """The full drill: the build SUBPROCESS is killed by the fault
+    harness between block flushes; the rerun (resume on by default)
+    recomputes only the missing tail, the finished index is bit-identical
+    to an uninterrupted build, and the resume proves itself through
+    ``build_blocks_resumed_total``."""
+    from distributed_oracle_search_tpu.testing.faults import KILL_EXIT_CODE
+
+    xy = str(tmp_path / "g.xy")
+    write_xy(xy, toy_graph.xs, toy_graph.ys, toy_graph.src,
+             toy_graph.dst, toy_graph.w)
+    outdir = str(tmp_path / "idx")
+    refdir = str(tmp_path / "ref")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               DOS_FAULTS="crash-build;after=0;times=1;mode=exit")
+    cmd = [sys.executable, "-m",
+           "distributed_oracle_search_tpu.worker.build",
+           "--input", xy, "--partmethod", "div", "--partkey", "24",
+           "--workerid", "0", "--maxworker", "2",
+           "--outdir", outdir, "--block-size", "8"]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == KILL_EXIT_CODE, r.stderr[-2000:]
+    survivors = sorted(f for f in os.listdir(outdir)
+                       if f.endswith(".npy"))
+    assert survivors == [shard_block_name(0, 0)]   # died after block 0
+
+    # rerun in-process (counters observable) with resume on
+    dc = DistributionController("div", 24, 2, toy_graph.n, block_size=8)
+    r0 = M_BLOCKS_RESUMED.value
+    written = build_worker_shard(toy_graph, dc, 0, outdir)
+    assert M_BLOCKS_RESUMED.value - r0 > 0
+    assert shard_block_name(0, 0) not in written   # only the tail
+    build_worker_shard(toy_graph, dc, 0, refdir)
+    idx_files = sorted(f for f in os.listdir(outdir)
+                       if f.endswith(".npy"))
+    ref_files = sorted(f for f in os.listdir(refdir)
+                       if f.endswith(".npy"))
+    assert idx_files == ref_files
+    for f in idx_files:
+        assert (open(os.path.join(outdir, f), "rb").read()
+                == open(os.path.join(refdir, f), "rb").read()), f
+    # the healed shard carries a digest-clean manifest
+    write_index_manifest(outdir, dc, workers=[0])
+    assert verify_exit_code(verify_index(outdir)) == 0
