@@ -29,6 +29,9 @@ grid + arterials; see ``data/synth.py``). Sections (env-gated):
              then an open-loop Poisson drill at a fraction of measured
              capacity — q/s, p50/p95/p99 latency, zipf cache hit rate,
              mean micro-batch fill                   (BENCH_SERVE=0 skips)
+  replication  R=2 failover drill — q/s + p99 with and without one
+             killed primary (breaker forced open), plus hedge win rate
+             under an injected primary delay          (BENCH_REPL=0 skips)
 
 All speedups are against a MEASURED native-engine run on this host's
 cpu_cores core(s); *_parity_cores fields give the OpenMP core count a
@@ -1568,6 +1571,162 @@ def main() -> None:
             f"mean batch fill {mean_fill:.1f}, "
             f"shed {serve_stats['serve_shed']}")
 
+    # ---- replication section: failover throughput/latency with a
+    # killed primary, and hedge win rate under an injected delay fault.
+    # A small dedicated 2-worker R=2 host-style world (block files +
+    # EngineDispatcher) — the figures characterize the routing layer,
+    # not the kernels, so a small graph keeps it honest and cheap.
+    # BENCH_REPL=0 skips.
+    repl_stats = {}
+    if os.environ.get("BENCH_REPL", "1") != "0":
+        from distributed_oracle_search_tpu.data import (
+            ensure_synth_dataset, read_scen,
+        )
+        from distributed_oracle_search_tpu.data.graph import Graph
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_replica_shards, build_worker_shard,
+            write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.obs import (
+            metrics as _robs,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            EngineDispatcher, HedgeConfig, ServeConfig, ServingFrontend,
+        )
+        from distributed_oracle_search_tpu.transport import resilience
+        from distributed_oracle_search_tpu.transport.wire import (
+            RuntimeConfig,
+        )
+        from distributed_oracle_search_tpu.utils.config import (
+            ClusterConfig,
+        )
+
+        def _rc(name):
+            return _robs.REGISTRY.snapshot()["counters"].get(name, 0)
+
+        log("replication (failover + hedged dispatch drills)...")
+        rdir = tempfile.mkdtemp(prefix="bench-repl-")
+        rpaths = ensure_synth_dataset(rdir, width=24, height=18,
+                                      n_queries=512, seed=31)
+        rconf_c = ClusterConfig(
+            workers=["localhost"] * 2, partmethod="mod", partkey=2,
+            outdir=os.path.join(rdir, "index"),
+            xy_file=rpaths["xy"], scenfile=rpaths["scen"], nfs=rdir,
+            replication=2).validate()
+        rg = Graph.from_xy(rconf_c.xy_file)
+        rdc = DistributionController("mod", 2, 2, rg.n, replication=2)
+        for wid in range(2):
+            build_worker_shard(rg, rdc, wid, rconf_c.outdir)
+            build_replica_shards(rg, rdc, wid, rconf_c.outdir)
+        write_index_manifest(rconf_c.outdir, rdc)
+        rqueries = read_scen(rconf_c.scenfile)
+        rn = int(os.environ.get("BENCH_REPL_REQUESTS", 512))
+        pool = rqueries[np.arange(rn) % len(rqueries)]
+        rrconf = RuntimeConfig()
+        disp = EngineDispatcher(rconf_c, graph=rg, dc=rdc)
+        # warm every engine (primary + replica lanes) off the clock
+        for wid in range(2):
+            mine = rqueries[rdc.worker_of(rqueries[:, 1]) == wid][:64]
+            disp.answer_batch(wid, mine, rrconf, "-")
+            disp.answer_batch(wid, mine, rrconf, "-",
+                              via=(wid + 1) % 2)
+
+        def _drill(registry, hconf, tag):
+            """Closed-loop drill: submit the pool, wait for every
+            answer; per-request latency measured submit -> t_done."""
+            fe = ServingFrontend(
+                rdc, disp,
+                sconf=ServeConfig(max_batch=64, max_wait_ms=2.0,
+                                  queue_depth=max(rn, 1024),
+                                  cache_bytes=0,
+                                  deadline_ms=600_000.0),
+                registry=registry, hconf=hconf)
+            fe.start()
+            t0 = time.perf_counter()
+            submits, futs = [], []
+            for s, t in pool:
+                submits.append(time.monotonic())
+                futs.append(fe.submit(int(s), int(t)))
+            res = [f.result(600) for f in futs]
+            wall = time.perf_counter() - t0
+            fe.stop()
+            n_ok = sum(r.ok for r in res)
+            lat_ms = [(r.t_done - ts) * 1e3
+                      for r, ts in zip(res, submits) if r.ok]
+            p99 = float(np.percentile(lat_ms, 99)) if lat_ms else float(
+                "nan")
+            log(f"  {tag}: {n_ok}/{rn} ok in {wall:.2f}s "
+                f"({n_ok / wall:,.0f} q/s, p99 {p99:.1f} ms)")
+            return n_ok, wall, p99
+
+        # clean baseline (no failures, hedging off)
+        ok_clean, wall_clean, p99_clean = _drill(
+            None, HedgeConfig(enabled=False), "clean")
+        # failover: worker 0's breaker forced OPEN — every shard-0
+        # batch re-routes to worker 1's replica
+        f0 = _rc("failover_total")
+        reg = resilience.BreakerRegistry(threshold=1, cooldown_s=600.0,
+                                         enabled=True)
+        reg.record(0, ok=False)
+        ok_fo, wall_fo, p99_fo = _drill(
+            reg, HedgeConfig(enabled=False), "failover (primary dead)")
+        reg.shutdown()
+        failovers = _rc("failover_total") - f0
+
+        # hedge drill: the primary lane of shard 0 answers slowly (the
+        # in-process analog of the `delay` fault); hedges should win
+        class _SlowPrimary:
+            def __init__(self, inner, slow_wid, delay_s):
+                self.inner, self.slow, self.d = inner, slow_wid, delay_s
+
+            def answer_batch(self, wid, q, rc_, diff, via=None):
+                if (wid if via is None else via) == self.slow:
+                    time.sleep(self.d)
+                return self.inner.answer_batch(wid, q, rc_, diff,
+                                               via=via)
+
+        hi0, hw0 = _rc("hedges_issued_total"), _rc("hedges_won_total")
+        hbudget = float(os.environ.get("BENCH_REPL_HEDGE_BUDGET", 0.5))
+        fe_h = ServingFrontend(
+            rdc, _SlowPrimary(disp, 0, 0.05),
+            sconf=ServeConfig(max_batch=64, max_wait_ms=1.0,
+                              queue_depth=1024, cache_bytes=0,
+                              deadline_ms=600_000.0),
+            hconf=HedgeConfig(enabled=True, min_delay_ms=5.0,
+                              budget=hbudget))
+        fe_h.start()
+        hpool = pool[:min(rn, 256)]
+        t0 = time.perf_counter()
+        hres = [fe_h.query(int(s), int(t), timeout=600)
+                for s, t in hpool]
+        wall_h = time.perf_counter() - t0
+        fe_h.stop()
+        time.sleep(0.3)          # drain loser primary threads
+        hedges = _rc("hedges_issued_total") - hi0
+        wins = _rc("hedges_won_total") - hw0
+        repl_stats = {
+            "repl_clean_queries_per_sec": round(ok_clean / wall_clean,
+                                                1),
+            "repl_clean_p99_ms": round(p99_clean, 3),
+            "repl_failover_queries_per_sec": round(ok_fo / wall_fo, 1),
+            "repl_failover_p99_ms": round(p99_fo, 3),
+            "repl_failover_ok": int(ok_fo),
+            "repl_failover_total": int(failovers),
+            "repl_hedges_issued": int(hedges),
+            "repl_hedges_won": int(wins),
+            "repl_hedge_win_rate": round(wins / max(hedges, 1), 3),
+            "repl_hedge_rate": round(fe_h.hedge.hedge_rate(), 3),
+            "repl_hedged_queries_per_sec": round(
+                sum(r.ok for r in hres) / wall_h, 1),
+        }
+        log(f"replication: clean "
+            f"{repl_stats['repl_clean_queries_per_sec']:,.0f} q/s, "
+            f"failover {repl_stats['repl_failover_queries_per_sec']:,.0f}"
+            f" q/s ({failovers} failovers, {ok_fo}/{rn} ok), hedge "
+            f"win rate {repl_stats['repl_hedge_win_rate']:.0%} at "
+            f"hedge rate {repl_stats['repl_hedge_rate']:.2f}")
+        shutil.rmtree(rdir, ignore_errors=True)
+
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     detail = {
         "graph_nodes": g.n,
@@ -1604,6 +1763,7 @@ def main() -> None:
         **road_stats,
         **weak_stats,
         **serve_stats,
+        **repl_stats,
         "devices": len(devices),
         "platform": devices[0].platform,
     }

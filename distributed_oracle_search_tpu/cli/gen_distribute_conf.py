@@ -30,14 +30,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--partmethod", required=True,
                    choices=["div", "mod", "alloc", "tpu"])
     p.add_argument("--partkey", type=int, nargs="+", default=[1])
+    p.add_argument("--replication", type=int, default=None,
+                   help="R-way replica placement: appends rep<r> "
+                        "columns naming the worker hosting each node's "
+                        "rank-r replica (default: DOS_REPLICATION or 1; "
+                        "1 emits the legacy 4-column format)")
     return p
 
 
 def main(argv=None) -> int:
+    from ..utils.env import env_cast
+
     args = build_parser().parse_args(argv)
     partkey = args.partkey if args.partmethod == "alloc" else args.partkey[0]
+    replication = args.replication
+    if replication is None:
+        # env policy: a malformed or out-of-range DOS_REPLICATION
+        # degrades to the legacy table (the explicit flag still raises)
+        replication = env_cast("DOS_REPLICATION", 1, int)
+        if not 1 <= replication <= args.maxworker:
+            replication = 1
     dc = DistributionController(args.partmethod, partkey, args.maxworker,
-                                args.nodenum)
+                                args.nodenum, replication=replication)
     try:
         print(dc.format_conf())
     except BrokenPipeError:  # downstream `| head` closed the pipe; not an error

@@ -24,6 +24,7 @@ ledger-based crash-resume (on by default).
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 from .args import parse_args
@@ -59,6 +60,9 @@ def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0,
         cmd += f" --chunk {chunk}"
     if not resume:
         cmd += " --no-resume"
+    repl = conf.effective_replication()
+    if repl > 1:
+        cmd += f" --replication {repl}"
     return cmd
 
 
@@ -85,19 +89,33 @@ def run_verify(conf: ClusterConfig) -> int:
     from ..models.cpd import read_manifest, verify_index, verify_exit_code
     from ..parallel.partition import DistributionController
 
-    # verify against the manifest's own block_size (a worker.build
-    # --block-size index is still a valid index); the partition
-    # quadruple is still cross-checked against the conf
+    # verify against the manifest's own block_size and replication (a
+    # worker.build --block-size or replicated index is still a valid
+    # index); the partition quadruple is still cross-checked against
+    # the conf
     dc_kw = {}
     try:
-        bs = int(read_manifest(conf.outdir).get("block_size", 0))
+        man = read_manifest(conf.outdir)
+        bs = int(man.get("block_size", 0))
         if bs > 0:
             dc_kw["block_size"] = bs
+        repl = int(man.get("replication", 1))
+        if repl > 1:
+            dc_kw["replication"] = repl
     except (OSError, ValueError):
         pass            # verify_index will report the unusable manifest
-    dc = DistributionController(conf.partmethod, conf.partkey,
-                                conf.maxworker,
-                                xy_node_count(conf.xy_file), **dc_kw)
+    try:
+        dc = DistributionController(conf.partmethod, conf.partkey,
+                                    conf.maxworker,
+                                    xy_node_count(conf.xy_file), **dc_kw)
+    except ValueError as e:
+        # e.g. the manifest records replication > this conf's
+        # maxworker: a manifest/conf mismatch is the contract's exit 4
+        # (fatal), never a traceback
+        log.error("verify fatal: %s", e)
+        print(json.dumps({"index": conf.outdir, "exit_code": 4,
+                          "fatal": str(e)}))
+        return 4
     report = verify_index(conf.outdir, dc=dc)
     for fname in report["missing"]:
         log.error("missing block: %s", fname)
@@ -163,20 +181,52 @@ def run_host(conf: ClusterConfig, args) -> None:
     if procs and not failures and args.worker == -1:
         # all local builds done -> finalize the index manifest
         from ..data.formats import xy_node_count
-        from ..models.cpd import write_index_manifest
+        from ..models.cpd import (
+            anti_entropy, build_replica_shards, write_index_manifest,
+        )
         from ..parallel.partition import DistributionController
         dc = DistributionController(conf.partmethod, conf.partkey,
                                     conf.maxworker,
-                                    xy_node_count(conf.xy_file))
-        write_index_manifest(conf.outdir, dc)
+                                    xy_node_count(conf.xy_file),
+                                    replication=conf
+                                    .effective_replication())
+        graph = None
+        if dc.replication > 1:
+            # backstop for builders that only emit primaries (the
+            # native engine, or replica builds that raced a peer's
+            # primary): materialize replica sets with files still
+            # MISSING on disk (existence scan only — the workers'
+            # ledgers already digest-verified what they wrote, and the
+            # anti-entropy pass below digest-checks everything once)
+            from ..models.cpd import shard_block_name
+            from ..data.graph import Graph as _Graph
+            graph = _Graph.from_xy(conf.xy_file)
+            bs = dc.block_size
+            for host in range(conf.maxworker):
+                missing = any(
+                    not os.path.exists(os.path.join(
+                        conf.outdir,
+                        shard_block_name(shard, bid,
+                                         dc.replica_rank(shard, host))))
+                    for shard in dc.replica_shards(host)[1:]
+                    for bid in range((dc.n_owned(shard) + bs - 1) // bs))
+                if missing:
+                    build_replica_shards(graph, dc, host, conf.outdir,
+                                         chunk=args.chunk)
+        manifest = write_index_manifest(conf.outdir, dc)
+        if dc.replication > 1:
+            report = anti_entropy(conf.outdir, dc, graph=graph,
+                                  manifest=manifest)
+            print(f"anti-entropy: {report['checked']} replica "
+                  f"block(s) cross-checked, "
+                  f"{len(report['mismatched'])} divergent, "
+                  f"{len(report['healed'])} healed")
         print(f"index complete -> {conf.outdir}")
     if failures:
         raise SystemExit(f"{failures} worker build(s) failed")
 
 
 def main(argv=None) -> int:
-    import os
-
     args = parse_args(argv, prog="make_cpds")
     set_verbosity(args.verbose)
     if args.test:

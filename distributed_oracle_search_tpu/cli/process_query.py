@@ -471,54 +471,92 @@ def send_queries(host: str, wid: int, part: np.ndarray, rconf: RuntimeConfig,
                  timeout: float | None = fifo_transport.DEFAULT_TIMEOUT,
                  trace_id: str = "", round_idx: int = 0,
                  policy: fifo_transport.RetryPolicy | None = None,
-                 registry: resilience.BreakerRegistry | None = None):
-    """One worker's batch: write the query file, push the request through
+                 registry: resilience.BreakerRegistry | None = None,
+                 candidates=None):
+    """One shard's batch: write the query file, push the request through
     the command FIFO, read the stats line (parity: reference
     ``process_query.py:82-111``). A non-empty ``trace_id`` stamps the
     batch's head-side spans AND rides the wire so the worker captures its
     half under the same id.
 
-    Returns ``(row_list, failure)`` where ``failure`` is None on success
-    or a dict describing the failed batch for the ``degraded.json``
-    manifest. An OPEN circuit breaker short-circuits the whole batch to
-    an instant failure row — no query file, no FIFO wait."""
-    def _failure(reason: str) -> dict:
-        return {"wid": wid, "host": host, "round": round_idx,
-                "diff": diff, "size": int(len(part)), "reason": reason}
+    ``candidates``: the shard's replica chain as ``(host, wid)`` pairs
+    in failover order (default: just the primary — the R=1 behavior).
+    A candidate whose circuit breaker is OPEN is skipped without a
+    send, and when ``send_with_retry`` exhausts on one candidate the
+    batch re-routes to the next (``failover_total``) — only a batch
+    every replica refused or failed is booked degraded.
 
-    key = (host, wid)
-    if registry is not None and not registry.allow(key):
-        log.error("circuit OPEN for worker %d on %s; batch "
-                  "short-circuited", wid, host)
-        H_BATCHES.inc()
-        H_BATCH_FAIL.inc()
-        row = StatsRow.failed()
-        return (row.as_list(t_prepare=0.0, t_partition=t_partition,
-                            size=len(part)), _failure("circuit-open"))
-    with Timer() as prep, obs_trace.span("head.prepare", wid=wid,
-                                         trace_id=trace_id):
-        qfile = os.path.join(nfs, f"query.{host}{wid}")
-        write_query_file(qfile, part)
-    H_PREPARE.observe(prep.interval)
-    if trace_id:
-        rconf = dataclasses.replace(rconf, trace_id=trace_id)
-    req = Request(rconf, qfile, answer_fifo_path(nfs, host, wid), diff)
-    with Timer() as send, obs_trace.span("head.send", wid=wid, diff=diff,
-                                         trace_id=trace_id):
-        row = fifo_transport.send_with_retry(host, req,
-                                             command_fifo_path(wid),
-                                             timeout=timeout,
-                                             policy=policy, wid=wid)
-    H_SEND.observe(send.interval)
+    Returns ``(row_list, failure, served)`` where ``failure`` is None on
+    success or a dict describing the failed batch for the
+    ``degraded.json`` manifest, and ``served`` is the ``(host, wid)``
+    that answered (None on failure) — the extraction/trace collectors
+    read sidecars next to the query file the SERVING worker actually
+    saw."""
+    prep_total = [0.0]
+    last_qfile = [""]
+
+    def _attempt(key):
+        c_host, c_wid = key
+        # a failed-over batch must NOT share the replica's primary
+        # file/FIFO names: shard w's re-routed batch and the replica's
+        # OWN batch run concurrently in the same round, and a shared
+        # `query.<host><wid>` / `answer.<host><wid>` pair would tear.
+        # The primary attempt keeps the legacy names byte-for-byte.
+        suffix = "" if key == candidates[0] else f".s{wid}"
+        with Timer() as prep, obs_trace.span("head.prepare", wid=c_wid,
+                                             shard=wid,
+                                             trace_id=trace_id):
+            qfile = os.path.join(nfs, f"query.{c_host}{c_wid}{suffix}")
+            write_query_file(qfile, part)
+        H_PREPARE.observe(prep.interval)
+        prep_total[0] += prep.interval
+        last_qfile[0] = qfile
+        rc = (dataclasses.replace(rconf, trace_id=trace_id)
+              if trace_id else rconf)
+        req = Request(rc, qfile,
+                      answer_fifo_path(nfs, c_host, c_wid) + suffix,
+                      diff)
+        with Timer() as send, obs_trace.span("head.send", wid=c_wid,
+                                             shard=wid, diff=diff,
+                                             trace_id=trace_id):
+            row = fifo_transport.send_with_retry(
+                c_host, req, command_fifo_path(c_wid), timeout=timeout,
+                policy=policy, wid=c_wid)
+        H_SEND.observe(send.interval)
+        return row
+
+    candidates = list(candidates) if candidates else [(host, wid)]
+    row, served, reasons = resilience.send_failover(
+        candidates, _attempt, registry=registry)
     H_BATCHES.inc()
-    if registry is not None:
-        registry.record(key, row.ok)
-    if not row.ok:
-        H_BATCH_FAIL.inc()
-        log.error("worker %d on %s failed; marking row failed", wid, host)
-    return (row.as_list(t_prepare=prep.interval,
+    if row is None:
+        row = StatsRow.failed()
+    if served is not None:
+        if served != candidates[0]:
+            log.warning("shard %d batch failed over %s -> worker %d on "
+                        "%s", wid, [r for r in reasons], served[1],
+                        served[0])
+        return (row.as_list(t_prepare=prep_total[0],
+                            t_partition=t_partition, size=len(part)),
+                None, (served[0], served[1], last_qfile[0]))
+    H_BATCH_FAIL.inc()
+    # degraded reason keeps the R=1 vocabulary (chaos tests pin it):
+    # "circuit-open" when no candidate was even attempted, else
+    # "send-failed"; the per-candidate trail rides along for operators
+    reason = ("circuit-open"
+              if all(r == "circuit-open" for _, r in reasons)
+              else "send-failed")
+    log.error("shard %d batch failed on every replica: %s", wid,
+              [(k[1], r) for k, r in reasons])
+    failure = {"wid": wid, "host": host, "round": round_idx,
+               "diff": diff, "size": int(len(part)), "reason": reason}
+    if len(candidates) > 1:
+        failure["replicas_tried"] = [
+            {"host": k[0], "wid": k[1], "reason": r}
+            for k, r in reasons]
+    return (row.as_list(t_prepare=prep_total[0],
                         t_partition=t_partition, size=len(part)),
-            None if row.ok else _failure("send-failed"))
+            failure, None)
 
 
 def send_timeout_s(args) -> float:
@@ -586,16 +624,25 @@ def _run_host_rounds(conf, args, dc, diffs, groups, rconf, t_partition,
             j[0], j[1], j[2], rconf, conf.nfs, diff,
             t_partition=t_partition, timeout=timeout,
             trace_id=f"{base_tid}/w{j[1]}.d{di}" if tracing else "",
-            round_idx=di, policy=policy, registry=registry))
-        rows = [row for row, _failure in results]
-        failures.extend(f for _row, f in results if f is not None)
+            round_idx=di, policy=policy, registry=registry,
+            candidates=[(conf.workers[c], c)
+                        for c in dc.replica_workers(j[1])]))
+        rows = [row for row, _failure, _served in results]
+        failures.extend(f for _row, f, _served in results
+                        if f is not None)
         stats.append(rows)
+        served_by = {wid: served for (_h, wid, _p), (_r, _f, served)
+                     in zip(jobs, results) if served is not None}
         if tracing:
             # merge the workers' span sidecars for this round (absent
-            # when a worker predates the wire extension — skip quietly)
+            # when a worker predates the wire extension — skip quietly;
+            # sidecars sit next to the query file of the worker that
+            # actually SERVED the batch, which failover may have moved)
             for host, wid, part in jobs:
-                sidecar = obs_trace.trace_sidecar_for(
-                    os.path.join(conf.nfs, f"query.{host}{wid}"))
+                _h, _w, s_qfile = served_by.get(
+                    wid, (host, wid,
+                          os.path.join(conf.nfs, f"query.{host}{wid}")))
+                sidecar = obs_trace.trace_sidecar_for(s_qfile)
                 try:
                     obs_trace.ingest(obs_trace.read_events(sidecar))
                     os.remove(sidecar)
@@ -606,8 +653,10 @@ def _run_host_rounds(conf, args, dc, diffs, groups, rconf, t_partition,
             # each worker's .paths file from the first round only
             parts = []
             for host, wid, part in jobs:
-                pfile = paths_file_for(
-                    os.path.join(conf.nfs, f"query.{host}{wid}"))
+                _h, _w, s_qfile = served_by.get(
+                    wid, (host, wid,
+                          os.path.join(conf.nfs, f"query.{host}{wid}")))
+                pfile = paths_file_for(s_qfile)
                 try:
                     nodes, moves = read_paths_file(pfile)
                 except (OSError, ValueError) as e:
@@ -645,13 +694,21 @@ def run(conf: ClusterConfig, args):
     with Timer() as t_workload, obs_trace.span("head.partition"):
         partmethod, partkey = effective_partition(conf, args)
         nodenum = xy_node_count(conf.xy_file)
+        use_tpu = args.backend == "tpu" or (args.backend == "auto"
+                                            and partmethod == "tpu")
+        # replication is a host-wire concept (replica block sets on
+        # distinct workers + failover over the FIFO wire); the
+        # in-process mesh has no per-worker failure domain to replicate
+        # across, so TPU mode pins R=1
+        replication = 1 if use_tpu else conf.effective_replication()
+        if use_tpu and conf.effective_replication() > 1:
+            log.info("replication=%d ignored on the TPU backend "
+                     "(in-process mesh has one failure domain)",
+                     conf.effective_replication())
         dc = DistributionController(partmethod, partkey, conf.maxworker,
-                                    nodenum)
+                                    nodenum, replication=replication)
     H_PARTITION.observe(t_workload.interval)
     diffs = list(conf.diffs) if conf.diffs else list(args.diffs)
-
-    use_tpu = args.backend == "tpu" or (args.backend == "auto"
-                                        and partmethod == "tpu")
     if use_tpu:
         from ..parallel.multihost import initialize_from_conf
         initialize_from_conf(conf)

@@ -129,8 +129,10 @@ def _dc_for(conf: ClusterConfig):
     from ..parallel.partition import DistributionController
 
     return DistributionController(conf.partmethod, conf.partkey,
-                                  conf.maxworker, xy_node_count(
-                                      conf.xy_file))
+                                  conf.maxworker,
+                                  xy_node_count(conf.xy_file),
+                                  replication=conf
+                                  .effective_replication())
 
 
 def main(argv=None) -> int:

@@ -78,6 +78,14 @@ M_BLOCKS_REBUILT = obs_metrics.counter(
 M_BLOCKS_RESUMED = obs_metrics.counter(
     "build_blocks_resumed_total",
     "blocks skipped by a resumed build (ledger-verified complete)")
+M_REPLICA_MISMATCH = obs_metrics.counter(
+    "replica_digest_mismatches_total",
+    "replica blocks whose digest diverged from the primary's "
+    "(anti-entropy pass; quarantined + healed)")
+M_REPLICA_COPIED = obs_metrics.counter(
+    "replica_blocks_copied_total",
+    "replica blocks materialized by copying a digest-valid primary "
+    "block instead of recomputing from the graph")
 
 #: compressed device->host fm fetch below this raw size is not worth the
 #: extra device round trip (the count pass) — plain fetch instead
@@ -177,11 +185,28 @@ def _host_tree(tree):
     return jax.device_get(tree)
 
 
-def shard_block_name(wid: int, bid: int) -> str:
+def shard_block_name(wid: int, bid: int, replica: int = 0) -> str:
+    """Block file name. ``replica=0`` (the primary copy) keeps the
+    legacy name; replica rank r's copy — the SAME rows, hosted by worker
+    ``(wid + r) % W`` — is a separate block set ``cpd-w<wid>-r<r>-b<bid>``
+    so primaries and replicas verify/heal independently."""
+    if replica:
+        return f"cpd-w{wid:05d}-r{replica:02d}-b{bid:05d}.npy"
     return f"cpd-w{wid:05d}-b{bid:05d}.npy"
 
 
-def ledger_path(outdir: str, wid: int) -> str:
+def block_file_replica(fname: str) -> int:
+    """Replica rank encoded in a block file name (0 for primaries)."""
+    parts = fname.split("-")
+    if len(parts) >= 4 and parts[2].startswith("r"):
+        return int(parts[2][1:])
+    return 0
+
+
+def ledger_path(outdir: str, wid: int, replica: int = 0) -> str:
+    if replica:
+        return os.path.join(outdir,
+                            f"build-w{wid:05d}-r{replica:02d}.ledger")
     return os.path.join(outdir, f"build-w{wid:05d}.ledger")
 
 
@@ -197,8 +222,8 @@ class BuildLedger:
     is skipped on read, costing at most one block's recompute. Later
     entries for the same file win, so a rebuilt block just appends."""
 
-    def __init__(self, outdir: str, wid: int):
-        self.path = ledger_path(outdir, wid)
+    def __init__(self, outdir: str, wid: int, replica: int = 0):
+        self.path = ledger_path(outdir, wid, replica)
 
     def entries(self) -> dict[str, dict]:
         out: dict[str, dict] = {}
@@ -358,7 +383,7 @@ def pick_build_kernel(graph: Graph, method: str = "auto"):
 def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
                        outdir: str, chunk: int = 0, max_iters: int = 0,
                        resume: bool = True,
-                       method: str = "auto") -> list[str]:
+                       method: str = "auto", replica: int = 0) -> list[str]:
     """Build and persist ONE worker's CPD block files on the local device.
 
     This is the host-mode build unit: the reference launches one
@@ -373,6 +398,14 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     accepted if they parse) — mid-build restart granularity the reference
     lacks (SURVEY.md §5 checkpoint/resume), now safe against torn writes:
     a build killed mid-flush recomputes exactly the missing tail.
+
+    ``replica``: build the rank-``replica`` REPLICA block set of shard
+    ``wid`` (same rows, ``-r<replica>-`` file names, its own ledger) —
+    the copy hosted by worker ``(wid + replica) % W``. The kernels are
+    deterministic, so a recomputed replica is bit-identical to the
+    primary; callers that have a digest-valid primary on the same
+    filesystem should prefer :func:`copy_replica_blocks` first and let
+    this recompute only what could not be copied.
     """
     from ..ops import build_fm_columns
     from ..ops.ell_split import build_fm_columns_ellsplit
@@ -390,8 +423,10 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     # process's rename into a crash
     import time as _time
     now = _time.time()
+    tmp_stem = (f"cpd-w{wid:05d}-r{replica:02d}-b*" if replica
+                else f"cpd-w{wid:05d}-b*")
     for p in glob.glob(os.path.join(
-            outdir, f"cpd-w{wid:05d}-*{TMP_SUFFIX}.*")):
+            outdir, f"{tmp_stem}{TMP_SUFFIX}.*")):
         try:
             if now - os.path.getmtime(p) >= SWEEP_MIN_AGE_S:
                 os.remove(p)
@@ -407,12 +442,12 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     # only the missing blocks are computed — a restart after a partial
     # build pays exactly for what is not yet on disk, and "on disk"
     # means ledger-journaled with a matching digest, not merely named
-    ledger = BuildLedger(outdir, wid)
+    ledger = BuildLedger(outdir, wid, replica)
     entries = ledger.entries() if resume else {}
     missing, resumed = [], 0
     for bid in range(n_blocks):
-        if resume and block_complete(outdir, shard_block_name(wid, bid),
-                                     entries):
+        if resume and block_complete(
+                outdir, shard_block_name(wid, bid, replica), entries):
             resumed += 1
         else:
             missing.append(bid)
@@ -460,7 +495,7 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         trimmed = [p[:ln] for p, ln in zip(parts, lens)]
         arr = (trimmed[0] if len(trimmed) == 1
                else np.concatenate(trimmed))
-        fname = shard_block_name(wid, bid)
+        fname = shard_block_name(wid, bid, replica)
         # atomic write, then the ledger line: a kill between the two
         # leaves a complete un-journaled file (the legacy-parse resume
         # path accepts it); a kill MID-write leaves only tmp debris
@@ -490,21 +525,87 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         if pending is not None:
             flush(pending)
         pending = (bid, lens, devs)
-        written.append(shard_block_name(wid, bid))
+        written.append(shard_block_name(wid, bid, replica))
     if pending is not None:
         flush(pending)
     return written
 
 
+def copy_replica_blocks(dc: DistributionController, shard: int,
+                        replica: int, outdir: str,
+                        resume: bool = True) -> list[str]:
+    """Materialize shard ``shard``'s rank-``replica`` block set by
+    copying digest-valid PRIMARY blocks — the cheap path when builder
+    and primary share a filesystem (the kernels are deterministic, so
+    the copy is exactly what a recompute would produce). Blocks whose
+    primary is missing or unparsable are skipped (the caller recomputes
+    them via :func:`build_worker_shard(..., replica=r)`). Copies go
+    through the same atomic-write + ledger journal as built blocks, so
+    resume/verify/heal treat them identically. Returns names written."""
+    os.makedirs(outdir, exist_ok=True)
+    owned = dc.n_owned(shard)
+    bs = dc.block_size
+    n_blocks = (owned + bs - 1) // bs
+    ledger = BuildLedger(outdir, shard, replica)
+    entries = ledger.entries() if resume else {}
+    prim_ledger = BuildLedger(outdir, shard).entries()
+    written = []
+    for bid in range(n_blocks):
+        fname = shard_block_name(shard, bid, replica)
+        if resume and block_complete(outdir, fname, entries):
+            continue
+        prim = shard_block_name(shard, bid)
+        prim_path = os.path.join(outdir, prim)
+        prim_ent = prim_ledger.get(prim)
+        rows, status, _reason = _verify_block(
+            prim_path,
+            {"digest": prim_ent["digest"]} if prim_ent else None,
+            want_rows=True)
+        if rows is None:
+            continue        # no healthy primary: caller recomputes
+        digest = atomic_save_npy(os.path.join(outdir, fname),
+                                 np.asarray(rows))
+        ledger.record(fname, digest, rows.shape, str(rows.dtype))
+        M_REPLICA_COPIED.inc()
+        written.append(fname)
+    return written
+
+
+def build_replica_shards(graph: Graph, dc: DistributionController,
+                         host_wid: int, outdir: str, chunk: int = 0,
+                         resume: bool = True,
+                         method: str = "auto") -> dict[int, list[str]]:
+    """Build every replica block set worker ``host_wid`` hosts (ranks
+    1..R-1 of :meth:`~..parallel.partition.DistributionController
+    .replica_shards`): copy from digest-valid primaries where possible,
+    recompute the rest from the graph. No-op at R=1. Returns
+    ``{shard: [files written]}``."""
+    out: dict[int, list[str]] = {}
+    for r in range(1, dc.replication):
+        shard = (host_wid - r) % dc.maxworker
+        copied = copy_replica_blocks(dc, shard, r, outdir, resume=resume)
+        computed = build_worker_shard(graph, dc, shard, outdir,
+                                      chunk=chunk, resume=True,
+                                      method=method, replica=r)
+        out[shard] = sorted(set(copied) | set(computed))
+        if copied or computed:
+            log.info("worker %d: replica r%d of shard %d ready "
+                     "(%d copied, %d computed)", host_wid, r, shard,
+                     len(copied), len(computed))
+    return out
+
+
 def _block_meta_for(outdir: str, fname: str,
-                    ledgers: dict[int, dict]) -> dict:
+                    ledgers: dict[tuple, dict]) -> dict:
     """Digest/shape/dtype for one block file, cheapest source first:
     the worker's build ledger (digest already computed from the written
     bytes), else read the file once."""
     wid = int(fname.split("-")[1][1:])
-    if wid not in ledgers:
-        ledgers[wid] = BuildLedger(outdir, wid).entries()
-    ent = ledgers[wid].get(fname)
+    replica = block_file_replica(fname)
+    key = (wid, replica)
+    if key not in ledgers:
+        ledgers[key] = BuildLedger(outdir, wid, replica).entries()
+    ent = ledgers[key].get(fname)
     if ent is not None and "digest" in ent:
         return {"digest": ent["digest"], "shape": list(ent["shape"]),
                 "dtype": ent["dtype"]}
@@ -534,6 +635,7 @@ def write_index_manifest(outdir: str, dc: DistributionController,
     target those workers own; other workers' rows load as "stuck".
     """
     files = []
+    replica_files = []
     bs = dc.block_size
     for wid in (range(dc.maxworker) if workers is None else workers):
         n_owned = dc.n_owned(wid)
@@ -544,9 +646,17 @@ def write_index_manifest(outdir: str, dc: DistributionController,
                     f"index incomplete: missing {fname} "
                     f"(worker {wid} block {bid})")
             files.append(fname)
-    ledgers: dict[int, dict] = {}
+            for r in range(1, dc.replication):
+                rname = shard_block_name(wid, bid, r)
+                if not os.path.exists(os.path.join(outdir, rname)):
+                    raise FileNotFoundError(
+                        f"index incomplete: missing replica {rname} "
+                        f"(shard {wid} block {bid} rank {r}, hosted by "
+                        f"worker {(wid + r) % dc.maxworker})")
+                replica_files.append(rname)
+    ledgers: dict[tuple, dict] = {}
     blocks = {}
-    for fname in files:
+    for fname in files + replica_files:
         meta = (block_meta or {}).get(fname)
         blocks[fname] = meta if meta is not None else _block_meta_for(
             outdir, fname, ledgers)
@@ -564,6 +674,12 @@ def write_index_manifest(outdir: str, dc: DistributionController,
         "files": files,
         "blocks": blocks,
     }
+    if dc.replication > 1:
+        # replica keys ride the same schema version: unknown keys are
+        # tolerated by every reader (the compat contract), and an R=1
+        # index stays byte-identical to the pre-replication format
+        manifest["replication"] = dc.replication
+        manifest["replica_files"] = replica_files
     atomic_write_json(os.path.join(outdir, "index.json"), manifest)
     return manifest
 
@@ -595,6 +711,10 @@ def validate_manifest(manifest: dict, dc: DistributionController,
             raise ValueError(
                 f"index {outdir} was built with {key}={manifest[key]}, "
                 f"controller has {mine}")
+    # replication is NOT a hard cross-check: an R=1 index serves an
+    # R>1 controller (replica sets just aren't on disk yet — failover
+    # loads fall back to primaries) and vice versa; the key is only
+    # meaningful to verify/anti-entropy passes, which read it directly.
 
 
 def check_manifest_version(manifest: dict, outdir: str) -> None:
@@ -679,11 +799,17 @@ def heal_block(outdir: str, manifest: dict | None, fname: str, wid: int,
     itself cannot produce a loadable block."""
     path = os.path.join(outdir, fname)
     qpath = quarantine(path)
+    replica = block_file_replica(fname)
     log.warning("CPD block %s is %s (%s); %srebuilding from the graph",
                 fname, status, reason,
                 f"quarantined to {qpath}; " if qpath else "")
-    with obs_trace.span("cpd.rebuild", file=fname, wid=wid):
-        build_worker_shard(graph, dc, wid, outdir)
+    with obs_trace.span("cpd.rebuild", file=fname, wid=wid,
+                        replica=replica):
+        if replica:
+            # a replica heals from its primary when one is on disk
+            # (digest-valid copy), recomputing only as a fallback
+            copy_replica_blocks(dc, wid, replica, outdir)
+        build_worker_shard(graph, dc, wid, outdir, replica=replica)
     rows, _status2, reason2 = load_verified_block(path, None)
     if rows is None:
         raise ValueError(
@@ -738,8 +864,10 @@ def verify_index(outdir: str, dc: DistributionController | None = None,
             report["fatal"] = str(e)
             return report
     blocks_meta = manifest.get("blocks", {})
-    report["total"] = len(manifest.get("files", []))
-    for fname in manifest.get("files", []):
+    all_files = (list(manifest.get("files", []))
+                 + list(manifest.get("replica_files", [])))
+    report["total"] = len(all_files)
+    for fname in all_files:
         with obs_trace.span("cpd.verify", file=fname):
             status, reason = check_block(os.path.join(outdir, fname),
                                          blocks_meta.get(fname))
@@ -754,6 +882,102 @@ def verify_index(outdir: str, dc: DistributionController | None = None,
         else:
             M_BLOCKS_CORRUPT.inc()
             report["corrupt"].append({"file": fname, "reason": reason})
+    return report
+
+
+def anti_entropy(outdir: str, dc: DistributionController,
+                 graph: Graph | None = None,
+                 manifest: dict | None = None, heal: bool = True) -> dict:
+    """Replica anti-entropy pass: cross-check every replica block's
+    crc32 digest against its PRIMARY's (the source of truth — primaries
+    are verified by the normal load/verify paths), quarantining and
+    healing divergent replicas in place.
+
+    For each shard block and replica rank, the pass compares the
+    on-disk replica digest to the primary's manifest/on-disk digest. A
+    mismatch books ``replica_digest_mismatches_total`` and — with
+    ``heal=True`` — quarantines the replica (``<file>.quarantined``)
+    and re-materializes it from the primary (or from the graph when
+    ``graph`` is given and the primary itself is unreadable), then
+    refreshes the manifest entry. Divergence here means a torn/rotted
+    replica OR a primary rebuilt under a different kernel since the
+    replica was copied; either way the primary wins.
+
+    Returns ``{"checked": n, "mismatched": [...], "healed": [...],
+    "missing_primary": [...]}``. No-op (all zeros) at R=1.
+    """
+    report: dict = {"checked": 0, "mismatched": [], "healed": [],
+                    "missing_primary": []}
+    if dc.replication <= 1:
+        return report
+    if manifest is None:
+        try:
+            manifest = read_manifest(outdir)
+        except (OSError, ValueError):
+            manifest = None
+    blocks_meta = (manifest or {}).get("blocks", {})
+    manifest_dirty = False
+    bs = dc.block_size
+    for shard in range(dc.maxworker):
+        n_blocks = (dc.n_owned(shard) + bs - 1) // bs
+        for bid in range(n_blocks):
+            prim = shard_block_name(shard, bid)
+            prim_path = os.path.join(outdir, prim)
+            prim_meta = blocks_meta.get(prim)
+            prim_digest = (prim_meta or {}).get("digest")
+            if prim_digest is None:
+                try:
+                    prim_digest = digest_file(prim_path)
+                except OSError:
+                    report["missing_primary"].append(prim)
+                    continue      # nothing to cross-check against
+            for r in range(1, dc.replication):
+                rname = shard_block_name(shard, bid, r)
+                rpath = os.path.join(outdir, rname)
+                report["checked"] += 1
+                try:
+                    got = digest_file(rpath)
+                except OSError:
+                    got = None        # missing replica = divergent
+                if got == prim_digest:
+                    continue
+                M_REPLICA_MISMATCH.inc()
+                report["mismatched"].append(
+                    {"file": rname, "digest": got,
+                     "primary_digest": prim_digest})
+                if not heal:
+                    continue
+                with obs_trace.span("cpd.anti_entropy", file=rname,
+                                    shard=shard, replica=r):
+                    quarantine(rpath)
+                    copied = copy_replica_blocks(dc, shard, r, outdir)
+                    if rname not in copied and graph is not None:
+                        build_worker_shard(graph, dc, shard, outdir,
+                                           replica=r)
+                rows, status, reason = load_verified_block(rpath, None)
+                if rows is None:
+                    log.error("anti-entropy could not heal %s: %s "
+                              "(%s)", rname, status, reason)
+                    continue
+                report["healed"].append(rname)
+                new_digest = digest_file(rpath)
+                if (manifest is not None
+                        and blocks_meta.get(rname, {}).get("digest")
+                        != new_digest):
+                    blocks_meta[rname] = {"digest": new_digest,
+                                          "shape": list(rows.shape),
+                                          "dtype": str(rows.dtype)}
+                    manifest_dirty = True
+    if manifest_dirty:
+        # one atomic manifest rewrite for the whole pass, not one per
+        # healed block
+        manifest["blocks"] = blocks_meta
+        atomic_write_json(os.path.join(outdir, "index.json"), manifest)
+    if report["mismatched"]:
+        log.warning("anti-entropy: %d/%d replica block(s) diverged "
+                    "from their primary (%d healed)",
+                    len(report["mismatched"]), report["checked"],
+                    len(report["healed"]))
     return report
 
 

@@ -96,6 +96,26 @@ README's "Artifact durability & resume"):
 * sweep — ``artifacts_swept_total`` (stale ``*.tmp`` debris and
   leftover ``*.quarantined`` blocks removed at build/campaign start,
   the artifact-plane analog of ``head_stale_fifos_cleaned_total``).
+
+Replication layer (R-way shard replication — failover routing, hedged
+dispatch, replica anti-entropy; README "Replication & failover"):
+
+* failover — ``failover_total`` (batches re-routed off a dead/failed
+  primary to a live replica; booked by the campaign head's
+  ``send_failover`` AND the serving frontend's dispatch loop),
+  ``server_replica_batches_total`` (batches a worker answered from a
+  hosted replica shard — the worker-side view of the same traffic);
+* hedging — ``hedges_issued_total`` / ``hedges_won_total`` (duplicates
+  sent after the adaptive per-shard latency-quantile delay, and how
+  often the replica beat the primary),
+  ``hedges_budget_denied_total`` (hedges declined by the
+  ``DOS_HEDGE_BUDGET`` rate cap — the overload-amplification guard),
+  per-shard ``serve_queue_depth_w<wid>`` gauges (failover load shifts
+  made visible per queue);
+* anti-entropy — ``replica_digest_mismatches_total`` (replica blocks
+  whose crc32 diverged from their primary's; quarantined + healed),
+  ``replica_blocks_copied_total`` (replica blocks materialized by
+  copying a digest-valid primary instead of recomputing).
 """
 
 from . import metrics, trace

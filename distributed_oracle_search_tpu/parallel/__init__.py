@@ -1,3 +1,3 @@
-from .partition import DistributionController
+from .partition import DistributionController, UNROUTABLE, parse_conf
 
-__all__ = ["DistributionController"]
+__all__ = ["DistributionController", "UNROUTABLE", "parse_conf"]

@@ -32,6 +32,17 @@ within the block (the reference's CPD builder emits one file per block:
 ``README.md:92``, and ``bid``/``bidx`` appear in ``gen_distribute_conf``
 output). ``bid * block_size + bidx`` is the node's dense **owned index** —
 its row in the worker's CPD shard.
+
+Replication (``replication`` / ``DOS_REPLICATION``, default 1): replica
+rank ``r`` of every node owned by worker ``w`` lives on worker
+``(w + r) % maxworker`` — chained declustering, a pure function of the
+primary partition table, so every head and worker derives the identical
+replica map from the same quadruple with no extra coordination. Rank 0
+is the primary; :meth:`DistributionController.replica_workers` is the
+failover order the head walks when a primary is dead, and
+:meth:`DistributionController.replica_shards` is the set of shards a
+worker must hold rows for. ``replication=1`` is byte-for-byte today's
+behavior everywhere (placement, wire format, artifacts).
 """
 
 from __future__ import annotations
@@ -40,17 +51,30 @@ import numpy as np
 
 DEFAULT_BLOCK_SIZE = 1 << 14
 
+#: the replica bucket :meth:`DistributionController.group_queries`
+#: returns queries under when EVERY replica of their target shard is in
+#: the caller's dead set — the caller must shed these UNAVAILABLE
+#: immediately instead of routing (or hanging on) a dead worker
+UNROUTABLE = -1
+
 
 class DistributionController:
     def __init__(self, partmethod: str, partkey, maxworker: int,
-                 nodenum: int, block_size: int = DEFAULT_BLOCK_SIZE):
+                 nodenum: int, block_size: int = DEFAULT_BLOCK_SIZE,
+                 replication: int = 1):
         self.partmethod = partmethod
         self.partkey = partkey
         self.maxworker = int(maxworker)
         self.nodenum = int(nodenum)
         self.block_size = int(block_size)
+        self.replication = int(replication)
         if self.maxworker <= 0:
             raise ValueError("maxworker must be positive")
+        if not 1 <= self.replication <= self.maxworker:
+            raise ValueError(
+                f"replication {self.replication} not in [1, "
+                f"maxworker={self.maxworker}]: every replica rank must "
+                "land on a distinct worker")
         self._wid = self._assign_all()
         # dense owned index per node: position within its owner's ascending
         # owned-node list. Vectorized: stable argsort by (wid, node).
@@ -107,6 +131,32 @@ class DistributionController:
         """Largest shard size — the padded per-device row count in TPU mode."""
         return int(self._counts.max()) if self.nodenum else 0
 
+    # ---------------------------------------------------------- replicas
+    def replica_workers(self, wid: int) -> list[int]:
+        """Workers hosting shard ``wid``'s rows, in failover order:
+        rank 0 is the primary (``wid`` itself), rank r the worker
+        ``(wid + r) % maxworker``. Length == ``replication``."""
+        return [(int(wid) + r) % self.maxworker
+                for r in range(self.replication)]
+
+    def replica_shards(self, wid: int) -> list[int]:
+        """Shards worker ``wid`` hosts rows for: its own (rank 0) plus
+        the shard whose rank-r replica lands here, ``(wid - r) %
+        maxworker``. The inverse of :meth:`replica_workers`."""
+        return [(int(wid) - r) % self.maxworker
+                for r in range(self.replication)]
+
+    def replica_rank(self, shard: int, host: int) -> int:
+        """The replica rank with which worker ``host`` holds ``shard``'s
+        rows (0 = primary). Raises ``ValueError`` when ``host`` is not
+        in the shard's replica set."""
+        r = (int(host) - int(shard)) % self.maxworker
+        if r >= self.replication:
+            raise ValueError(
+                f"worker {host} holds no replica of shard {shard} "
+                f"(replication={self.replication})")
+        return r
+
     def table(self) -> np.ndarray:
         """int64 [N, 4] rows of (node, wid, bid, bidx) — the
         ``gen_distribute_conf`` payload."""
@@ -115,16 +165,36 @@ class DistributionController:
         bidx = self._owned_idx % self.block_size
         return np.stack([nodes, self._wid, bid, bidx], axis=1)
 
+    def replica_table(self) -> np.ndarray:
+        """int64 [N, replication-1]: column r-1 is the worker hosting
+        replica rank r of each node. Empty (0 columns) at R=1."""
+        cols = [(self._wid + r) % self.maxworker
+                for r in range(1, self.replication)]
+        if not cols:
+            return np.zeros((self.nodenum, 0), np.int64)
+        return np.stack(cols, axis=1)
+
     def format_conf(self) -> str:
-        """The wire format the reference driver parses: one header line, then
-        ``node,wid,bid,bidx`` per node (reference ``process_query.py:50-53``)."""
+        """The wire format the reference driver parses: one header line,
+        then ``node,wid,bid,bidx`` per node (reference
+        ``process_query.py:50-53``). With replication, ``rep<r>`` columns
+        (the rank-r replica's worker) append on the right — same compat
+        contract as the wire codecs: readers take columns by header name
+        and tolerate unknown ones, so an R=1 consumer reading the first
+        four columns of an R>1 table still routes correctly, and R=1
+        output is byte-identical to the legacy format."""
         rows = self.table()
-        lines = ["node,wid,bid,bidx"]
-        lines += [f"{a},{b},{c},{d}" for a, b, c, d in rows]
+        rep = self.replica_table()
+        header = "node,wid,bid,bidx" + "".join(
+            f",rep{r}" for r in range(1, self.replication))
+        lines = [header]
+        lines += [",".join(map(str, [*row, *reps]))
+                  for row, reps in zip(rows, rep)]
         return "\n".join(lines)
 
     # ------------------------------------------------------------ routing
-    def group_queries(self, queries: np.ndarray, active_worker: int = -1):
+    def group_queries(self, queries: np.ndarray, active_worker: int = -1,
+                      dead=()):
         """Group (s, t) queries by the worker owning the **target** node — the
         system invariant (reference ``process_query.py:56-57``).
 
@@ -132,14 +202,83 @@ class DistributionController:
         the reference's parts list skips empty workers
         (``process_query.py:62``). ``active_worker`` restricts to one worker
         (the ``-w`` flag), -1 = all.
+
+        ``dead``: worker ids known down. Each query routes to the FIRST
+        live worker in its target shard's replica chain
+        (:meth:`replica_workers`); queries whose every replica is dead
+        come back under the :data:`UNROUTABLE` key so the caller can
+        shed them immediately instead of hanging on a dead worker. With
+        ``dead`` empty (the default) routing is identical to the
+        pre-replication behavior regardless of ``replication``.
         """
         queries = np.asarray(queries, np.int64)
         wids = self.worker_of(queries[:, 1])
+        dead = set(int(d) for d in dead)
+        if dead:
+            # remap each primary wid to its first live replica host
+            # (UNROUTABLE when the whole chain is dead) — one pass over
+            # the W shard ids, then a vectorized gather
+            remap = np.empty(self.maxworker, np.int64)
+            for shard in range(self.maxworker):
+                remap[shard] = next(
+                    (h for h in self.replica_workers(shard)
+                     if h not in dead), UNROUTABLE)
+            wids = remap[wids]
         groups = {}
-        for wid in range(self.maxworker):
-            if active_worker != -1 and wid != active_worker:
+        wid_range = ([UNROUTABLE] if dead else []) + list(
+            range(self.maxworker))
+        for wid in wid_range:
+            if active_worker != -1 and wid != active_worker \
+                    and wid != UNROUTABLE:
                 continue
             mask = wids == wid
             if mask.any():
                 groups[wid] = queries[mask]
         return groups
+
+
+def parse_conf(text: str) -> dict:
+    """Parse :meth:`DistributionController.format_conf` output back into
+    arrays — the consumer half of the ``gen_distribute_conf`` wire.
+
+    Columns are taken BY HEADER NAME with unknown columns tolerated
+    (the wire codecs' compat contract): a legacy R=1 table (no ``rep*``
+    columns) parses with ``replication == 1``, an R>1 table parsed by
+    old code that only reads the first four columns still routes on the
+    primary, and future columns cannot break this parser.
+
+    Returns ``{"node", "wid", "bid", "bidx": int64 [N];
+    "replicas": int64 [N, R-1]; "replication": R}``.
+    """
+    lines = [ln for ln in text.strip().split("\n") if ln.strip()]
+    if not lines:
+        raise ValueError("empty distribute conf")
+    header = [h.strip() for h in lines[0].split(",")]
+    for required in ("node", "wid", "bid", "bidx"):
+        if required not in header:
+            raise ValueError(
+                f"distribute conf header is missing {required!r}: "
+                f"{lines[0]!r}")
+    rep_cols = sorted(
+        (h for h in header if h.startswith("rep") and h[3:].isdigit()),
+        key=lambda h: int(h[3:]))
+    ranks = [int(h[3:]) for h in rep_cols]
+    if ranks != list(range(1, len(ranks) + 1)):
+        raise ValueError(f"replica columns are not ranks 1..R-1: "
+                         f"{rep_cols}")
+    idx = {h: i for i, h in enumerate(header)}
+    parsed = []
+    for ln in lines[1:]:
+        vals = ln.split(",")
+        if len(vals) < len(header):
+            raise ValueError(f"row has {len(vals)} columns, header "
+                             f"names {len(header)}: {ln!r}")
+        parsed.append([int(v) for v in vals[:len(header)]])
+    rows = np.asarray(parsed, np.int64).reshape(len(lines) - 1,
+                                                len(header))
+    out = {k: rows[:, idx[k]] for k in ("node", "wid", "bid", "bidx")}
+    out["replicas"] = (rows[:, [idx[c] for c in rep_cols]]
+                       if rep_cols
+                       else np.zeros((len(rows), 0), np.int64))
+    out["replication"] = len(rep_cols) + 1
+    return out

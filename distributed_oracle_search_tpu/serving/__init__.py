@@ -33,11 +33,21 @@ resilience, and observability layers the campaign path already uses:
   file-tail): one ``s t`` per line in, one result line out, responses
   in request order.
 
+With shard replication (``DOS_REPLICATION`` / conf ``replication`` >
+1) the frontend is replica-aware: admission sheds ``UNAVAILABLE`` only
+when EVERY replica of the target shard is breaker-dead, dispatch fails
+over to the next live replica (``failover_total``), and slow batches
+are hedged — a duplicate to a replica after the shard's adaptive
+latency-quantile delay, first answer wins, bounded by a hedge-rate
+budget (:mod:`~.hedge`, ``DOS_HEDGE_*`` knobs).
+
 Entry point: ``python -m distributed_oracle_search_tpu.cli.serve``
 (``dos-serve``). Env knobs: ``DOS_SERVE_QUEUE_DEPTH``,
 ``DOS_SERVE_MAX_BATCH``, ``DOS_SERVE_MAX_WAIT_MS``,
 ``DOS_SERVE_CACHE_BYTES``, ``DOS_SERVE_DEADLINE_MS`` (see
-:class:`~.config.ServeConfig`).
+:class:`~.config.ServeConfig`); ``DOS_HEDGE_QUANTILE``,
+``DOS_HEDGE_MIN_MS``, ``DOS_HEDGE_BUDGET``, ``DOS_HEDGE_WINDOW``,
+``DOS_HEDGE_DISABLE`` (see :class:`~.hedge.HedgeConfig`).
 """
 
 from .batcher import MicroBatcher
@@ -47,6 +57,7 @@ from .dispatch import (
     CallableDispatcher, DispatchError, EngineDispatcher, FifoDispatcher,
 )
 from .frontend import ServingFrontend
+from .hedge import HedgeConfig, HedgeTracker
 from .queue import ShardQueue
 from .request import (
     BUSY, ERROR, Future, OK, ServeRequest, ServeResult, TIMEOUT,
@@ -55,7 +66,8 @@ from .request import (
 
 __all__ = [
     "BUSY", "CallableDispatcher", "DispatchError", "ERROR",
-    "EngineDispatcher", "FifoDispatcher", "Future", "MicroBatcher", "OK",
+    "EngineDispatcher", "FifoDispatcher", "Future", "HedgeConfig",
+    "HedgeTracker", "MicroBatcher", "OK",
     "ResultCache", "ServeConfig", "ServeRequest", "ServeResult",
     "ServingFrontend", "ShardQueue", "TIMEOUT", "UNAVAILABLE",
     "knob_fingerprint",

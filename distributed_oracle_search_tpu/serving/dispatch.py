@@ -51,7 +51,15 @@ class DispatchError(RuntimeError):
 
 class EngineDispatcher:
     """In-process shard engines (the ``--backend inproc`` serving path
-    and the smoke-test harness)."""
+    and the smoke-test harness).
+
+    ``answer_batch``'s ``via`` routes the batch through a REPLICA
+    host's engine (failover off an open breaker, or the hedge's
+    duplicate): engines are keyed ``(shard, via)`` so the primary's and
+    each replica's row sets load independently — with ``build_missing``
+    (the ``--test`` path) a missing replica block set is materialized
+    lazily on first use (copied from the primary when it exists,
+    recomputed otherwise), so R=2 serve tests need no pre-build step."""
 
     def __init__(self, conf: ClusterConfig, graph=None,
                  dc: DistributionController | None = None,
@@ -63,41 +71,61 @@ class EngineDispatcher:
         self.graph = graph if graph is not None else Graph.from_xy(
             conf.xy_file)
         self.dc = dc if dc is not None else DistributionController(
-            conf.partmethod, conf.partkey, conf.maxworker, self.graph.n)
+            conf.partmethod, conf.partkey, conf.maxworker, self.graph.n,
+            replication=conf.effective_replication())
         self.alg = alg
         self.build_missing = build_missing
         self.build_chunk = build_chunk
-        self._engines: dict[int, object] = {}
+        self._engines: dict[tuple, object] = {}
         self._lock = threading.Lock()
 
-    def _engine_for(self, wid: int):
+    def _build_missing_shard(self, shard: int, replica: int) -> None:
+        from ..models.cpd import (
+            build_worker_shard, copy_replica_blocks,
+        )
+
+        log.info("no CPD %s for shard %d in %s; building in-process",
+                 f"replica r{replica}" if replica else "shard", shard,
+                 self.conf.outdir)
+        os.makedirs(self.conf.outdir, exist_ok=True)
+        if replica:
+            copy_replica_blocks(self.dc, shard, replica,
+                                self.conf.outdir)
+        build_worker_shard(self.graph, self.dc, shard, self.conf.outdir,
+                           chunk=self.build_chunk, replica=replica)
+
+    def _engine_for(self, wid: int, via: int | None = None):
         from ..worker.engine import ShardEngine
 
+        via = wid if via is None else int(via)
         with self._lock:
-            eng = self._engines.get(wid)
+            eng = self._engines.get((wid, via))
             if eng is None:
                 try:
-                    eng = ShardEngine(self.graph, self.dc, wid,
-                                      self.conf.outdir, alg=self.alg)
-                except FileNotFoundError:
+                    eng = ShardEngine(self.graph, self.dc, via,
+                                      self.conf.outdir, alg=self.alg,
+                                      shard=wid)
+                except (FileNotFoundError, ValueError):
+                    # ValueError covers a PARTIAL block set (a killed
+                    # lazy build left some blocks; the row count fails
+                    # the partition check): the resumed build below
+                    # recomputes exactly the missing tail. A genuine
+                    # partition mismatch rebuilds to the same mismatch
+                    # and the retry's raise propagates it.
                     if not self.build_missing:
                         raise
-                    from ..models.cpd import build_worker_shard
-
-                    log.info("no CPD shard for worker %d in %s; building "
-                             "in-process", wid, self.conf.outdir)
-                    os.makedirs(self.conf.outdir, exist_ok=True)
-                    build_worker_shard(self.graph, self.dc, wid,
-                                       self.conf.outdir,
-                                       chunk=self.build_chunk)
-                    eng = ShardEngine(self.graph, self.dc, wid,
-                                      self.conf.outdir, alg=self.alg)
-                self._engines[wid] = eng
+                    self._build_missing_shard(
+                        wid, self.dc.replica_rank(wid, via))
+                    eng = ShardEngine(self.graph, self.dc, via,
+                                      self.conf.outdir, alg=self.alg,
+                                      shard=wid)
+                self._engines[(wid, via)] = eng
             return eng
 
     def answer_batch(self, wid: int, queries: np.ndarray,
-                     rconf: RuntimeConfig, diff: str):
-        cost, plen, fin, _stats = self._engine_for(wid).answer(
+                     rconf: RuntimeConfig, diff: str,
+                     via: int | None = None):
+        cost, plen, fin, _stats = self._engine_for(wid, via).answer(
             queries, rconf, diff)
         return cost, plen, fin
 
@@ -123,67 +151,124 @@ class FifoDispatcher:
                         else fifo_transport.DEFAULT_TIMEOUT)
         self.policy = policy
         self._seq = itertools.count()
-        self._prev_qfile: dict[int, str] = {}
+        #: per dispatch lane ((shard, via) pair): the previous batch's
+        #: query file and answer-FIFO base, swept on the lane's next
+        #: dispatch / at close
+        self._prev: dict[tuple, tuple[str, str]] = {}
+        #: one mutex per lane: hedged dispatch broke the frontend's
+        #: one-batch-per-shard invariant for THIS layer (a losing
+        #: primary attempt can still be in flight when the runner
+        #: thread dispatches the shard's next batch on the same lane),
+        #: and the next batch's _sweep_prev must not unlink the loser's
+        #: in-flight query file / answer FIFOs. The worker's command
+        #: FIFO serializes same-worker batches anyway, so the lock adds
+        #: ordering, not latency.
+        self._lane_locks: dict[tuple, threading.Lock] = {}
+        self._locks_guard = threading.Lock()
 
-    def _sweep_prev(self, wid: int) -> None:
-        prev = self._prev_qfile.pop(wid, None)
+    def _lane_lock(self, lane: tuple) -> threading.Lock:
+        with self._locks_guard:
+            lock = self._lane_locks.get(lane)
+            if lock is None:
+                lock = self._lane_locks[lane] = threading.Lock()
+            return lock
+
+    def _sweep_prev(self, lane: tuple) -> None:
+        prev = self._prev.pop(lane, None)
         if not prev:
             return
-        for p in (prev, results_file_for(prev)):
+        import glob as _glob
+        import stat as _stat
+
+        qfile, answer_base = prev
+        for p in (qfile, results_file_for(qfile)):
             try:
                 os.remove(p)
             except OSError:
                 pass
+        # the per-attempt answer FIFOs (<base>.a<n>) are normally
+        # removed by the transfer script's own `rm -f`; a script killed
+        # on timeout never gets there, and an orphaned FIFO on the
+        # shared dir outlives the service. Only FIFOs are touched.
+        for p in _glob.glob(answer_base + ".a*"):
+            try:
+                if _stat.S_ISFIFO(os.stat(p).st_mode):
+                    os.remove(p)
+            except OSError:
+                pass
 
     def close(self) -> None:
-        """Sweep every shard's last batch files (called by
-        ``ServingFrontend.stop`` — without it each shard's FINAL
-        ``query.serve.*``/``.results`` pair would outlive the service
-        on the shared nfs dir)."""
-        for wid in list(self._prev_qfile):
-            self._sweep_prev(wid)
+        """Sweep every lane's last batch files — query file,
+        ``.results`` sidecar AND any per-attempt ``answer.*`` FIFOs a
+        timed-out transfer script orphaned (called by
+        ``ServingFrontend.stop``; without it the FINAL batch's debris
+        would outlive the service on the shared nfs dir). Lane locks
+        are taken best-effort: a loser attempt still in flight at
+        shutdown must not stall the stop for its full wire timeout."""
+        for lane in list(self._prev):
+            lock = self._lane_lock(lane)
+            got = lock.acquire(timeout=2.0)
+            try:
+                self._sweep_prev(lane)
+            finally:
+                if got:
+                    lock.release()
 
     def answer_batch(self, wid: int, queries: np.ndarray,
-                     rconf: RuntimeConfig, diff: str):
-        host = self.conf.workers[wid]
+                     rconf: RuntimeConfig, diff: str,
+                     via: int | None = None):
+        via = wid if via is None else int(via)
+        host = self.conf.workers[via]
         nfs = self.conf.nfs
-        self._sweep_prev(wid)
-        tag = f"{os.getpid()}.{next(self._seq)}"
-        qfile = os.path.join(nfs, f"query.serve.{host}{wid}.{tag}")
-        self._prev_qfile[wid] = qfile
-        write_query_file(qfile, queries)
-        req = Request(
-            dataclasses.replace(rconf, results=True), qfile,
-            answer_fifo_path(nfs, host, wid) + f".serve.{tag}", diff)
-        row = fifo_transport.send_with_retry(
-            host, req, command_fifo_path(wid), timeout=self.timeout,
-            policy=self.policy, wid=wid)
-        if not row.ok:
-            raise DispatchError(
-                f"worker {wid} on {host} failed a serving batch "
-                f"({len(queries)} queries)")
-        try:
-            cost, plen, fin = read_results_file(results_file_for(qfile))
-        except (OSError, ValueError) as e:
-            # an old server (pre-`results` wire key) answers the stats
-            # line but writes no sidecar — a hard error here, not a
-            # silent all-zeros answer
-            raise DispatchError(
-                f"worker {wid} on {host} returned no results sidecar "
-                f"(server predates the wire extension?): {e}") from e
-        if len(cost) != len(queries):
-            raise DispatchError(
-                f"worker {wid} results length {len(cost)} != batch "
-                f"{len(queries)}")
-        return cost, plen, fin
+        lane = (wid, via)
+        with self._lane_lock(lane):
+            self._sweep_prev(lane)
+            tag = f"{os.getpid()}.{next(self._seq)}"
+            qfile = os.path.join(nfs, f"query.serve.{host}{via}.{tag}")
+            answer_base = (answer_fifo_path(nfs, host, via)
+                           + f".serve.{tag}")
+            self._prev[lane] = (qfile, answer_base)
+            write_query_file(qfile, queries)
+            req = Request(
+                dataclasses.replace(rconf, results=True), qfile,
+                answer_base, diff)
+            row = fifo_transport.send_with_retry(
+                host, req, command_fifo_path(via), timeout=self.timeout,
+                policy=self.policy, wid=via)
+            if not row.ok:
+                raise DispatchError(
+                    f"worker {via} on {host} failed a serving batch "
+                    f"({len(queries)} queries for shard {wid})")
+            try:
+                cost, plen, fin = read_results_file(
+                    results_file_for(qfile))
+            except (OSError, ValueError) as e:
+                # an old server (pre-`results` wire key) answers the
+                # stats line but writes no sidecar — a hard error here,
+                # not a silent all-zeros answer
+                raise DispatchError(
+                    f"worker {via} on {host} returned no results "
+                    f"sidecar (server predates the wire extension?): "
+                    f"{e}") from e
+            if len(cost) != len(queries):
+                raise DispatchError(
+                    f"worker {via} results length {len(cost)} != batch "
+                    f"{len(queries)}")
+            return cost, plen, fin
 
 
 class CallableDispatcher:
-    """Wrap ``fn(wid, queries, rconf, diff) -> (cost, plen, finished)``."""
+    """Wrap ``fn(wid, queries, rconf, diff) -> (cost, plen, finished)``.
+
+    ``via`` is accepted for interface parity and ignored: a callable
+    backend has no per-worker placement, so replica routing is a no-op
+    (tests that need via-sensitive behavior implement ``answer_batch``
+    directly)."""
 
     def __init__(self, fn):
         self.fn = fn
 
     def answer_batch(self, wid: int, queries: np.ndarray,
-                     rconf: RuntimeConfig, diff: str):
+                     rconf: RuntimeConfig, diff: str,
+                     via: int | None = None):
         return self.fn(wid, queries, rconf, diff)

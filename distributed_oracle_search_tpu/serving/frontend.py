@@ -23,6 +23,8 @@ observable from day one like the campaign path.
 
 from __future__ import annotations
 
+import queue as _stdqueue
+import threading
 import time
 
 import numpy as np
@@ -30,11 +32,13 @@ import numpy as np
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
+from ..transport import resilience
 from ..transport.wire import RuntimeConfig
 from ..utils.log import get_logger
 from .batcher import MicroBatcher
 from .cache import ResultCache, knob_fingerprint
 from .config import ServeConfig
+from .hedge import HedgeConfig, HedgeTracker, M_BUDGET_DENIED, M_WON
 from .queue import ShardQueue
 from .request import (
     BUSY, ERROR, Future, OK, ServeRequest, ServeResult, TIMEOUT,
@@ -74,7 +78,8 @@ class ServingFrontend:
     def __init__(self, dc: DistributionController, dispatcher,
                  sconf: ServeConfig | None = None,
                  rconf: RuntimeConfig | None = None,
-                 diff: str = "-", registry=None, breaker_key=None):
+                 diff: str = "-", registry=None, breaker_key=None,
+                 hconf: HedgeConfig | None = None):
         self.dc = dc
         self.dispatcher = dispatcher
         self.sconf = sconf or ServeConfig.from_env()
@@ -84,10 +89,20 @@ class ServingFrontend:
         self._breaker_key = breaker_key or (lambda wid: wid)
         self._fp = knob_fingerprint(self.rconf)
         self.cache = ResultCache(self.sconf.cache_bytes)
+        #: hedged dispatch (replicated shards only): per-shard latency
+        #: quantiles drive the duplicate-request delay, a rate budget
+        #: bounds the duplicates
+        self.hedge = HedgeTracker(hconf or HedgeConfig.from_env())
         self._queues: dict[int, ShardQueue] = {}
         self._batchers: dict[int, MicroBatcher] = {}
         for wid in range(dc.maxworker):
-            q = ShardQueue(self.sconf.queue_depth)
+            q = ShardQueue(
+                self.sconf.queue_depth,
+                gauge=obs_metrics.gauge(
+                    f"serve_queue_depth_w{wid}",
+                    f"requests queued on shard {wid}'s queue (its "
+                    "primary's lane; failover/hedges drain it via "
+                    "replicas)") if dc.replication > 1 else None)
             self._queues[wid] = q
             self._batchers[wid] = MicroBatcher(
                 wid, q,
@@ -152,11 +167,23 @@ class ServingFrontend:
                 cached=True), now)
         wid = int(self.dc.worker_of(t))   # scalar index, no per-request
         # array allocation on the admission hot path
-        if (self.registry is not None
-                and not self.registry.allow(self._breaker_key(wid))):
-            M_UNAVAIL.inc()
-            return self._immediate(ServeResult(
-                UNAVAILABLE, s, t, detail="circuit-open"), now)
+        if self.registry is not None:
+            if self.dc.replication == 1:
+                # unreplicated: the pre-replication admission path,
+                # byte for byte (allow() keeps its trial semantics)
+                if not self.registry.allow(self._breaker_key(wid)):
+                    M_UNAVAIL.inc()
+                    return self._immediate(ServeResult(
+                        UNAVAILABLE, s, t, detail="circuit-open"), now)
+            elif not any(
+                    self.registry.available(self._breaker_key(c))
+                    for c in self.dc.replica_workers(wid)):
+                # every replica of the target shard is breaker-dead:
+                # shed NOW — queueing would only turn a fast explicit
+                # answer into a deadline'd hang
+                M_UNAVAIL.inc()
+                return self._immediate(ServeResult(
+                    UNAVAILABLE, s, t, detail="no-live-replica"), now)
         req = ServeRequest(s=s, t=t, wid=wid, key=key, t_submit=now,
                            deadline=now + self.sconf.deadline_s)
         if not self._queues[wid].try_put(req):
@@ -222,26 +249,54 @@ class ServingFrontend:
         if not live:
             return
         queries = np.asarray([[r.s, r.t] for r in live], np.int64)
-        key = self._breaker_key(wid)
         # pin the diff actually dispatched: a set_diff racing this batch
         # must not let answers computed under the NEW diff be cached
         # under requests' submit-time (old-diff) keys
         diff = self.diff
         err = ""
-        try:
-            with obs_trace.span("serve.dispatch", wid=wid,
-                                size=len(live)):
-                cost, plen, fin = self.dispatcher.answer_batch(
-                    wid, queries, self.rconf, diff)
-            ok = True
-        except Exception as e:  # noqa: BLE001 — any dispatch failure
-            # becomes per-request ERROR + a breaker failure record
-            log.exception("shard w%d serving batch failed: %s", wid, e)
-            ok = False
-            err = f"{type(e).__name__}: {e}"
-        if self.registry is not None:
-            self.registry.record(key, ok)
+        ok = False
+        cost = plen = fin = None
+        candidates = self.dc.replica_workers(wid)
+        attempted = False
+        failed_over = False
+        for via in candidates:
+            key = self._breaker_key(via)
+            if (len(candidates) > 1 and self.registry is not None
+                    and not self.registry.allow(key)):
+                # dead replica: skip without a dispatch (R=1 keeps the
+                # admission-time breaker semantics — no second gate)
+                continue
+            if attempted or via != wid:
+                if not failed_over:
+                    failed_over = True
+                    resilience.M_FAILOVER.inc()
+                log.warning("shard w%d batch failing over to replica "
+                            "host w%d", wid, via)
+            attempted = True
+            try:
+                cost, plen, fin = self._dispatch_hedged(
+                    wid, via, candidates, queries, diff)
+                ok = True
+            except Exception as e:  # noqa: BLE001 — any dispatch
+                # failure becomes a breaker failure record (booked by
+                # the attempt itself, see _dispatch_hedged) + (once the
+                # chain is exhausted) per-request ERROR
+                log.exception("shard w%d serving batch via w%d "
+                              "failed: %s", wid, via, e)
+                err = f"{type(e).__name__}: {e}"
+            if ok:
+                break
         if not ok:
+            if not attempted:
+                # every replica's breaker was open at dispatch time
+                # (they half-opened away again since admission): shed
+                # rather than hang — the admission guarantee holds at
+                # dispatch too
+                for r in live:
+                    M_UNAVAIL.inc()
+                    self._finish(r, ServeResult(
+                        UNAVAILABLE, r.s, r.t, detail="no-live-replica"))
+                return
             for r in live:
                 M_ERRORS.inc()
                 self._finish(r, ServeResult(ERROR, r.s, r.t, detail=err))
@@ -253,3 +308,112 @@ class ServingFrontend:
             M_OK.inc()
             self._finish(r, ServeResult(OK, r.s, r.t, cost=val[0],
                                         plen=val[1], finished=val[2]))
+
+    # ------------------------------------------------- hedged dispatch
+    def _answer_once(self, wid: int, via: int, queries, diff: str):
+        with obs_trace.span("serve.dispatch", wid=via, shard=wid,
+                            size=len(queries)):
+            return self.dispatcher.answer_batch(
+                wid, queries, self.rconf, diff, via=via)
+
+    def _hedge_target(self, wid: int, via: int, candidates) -> int | None:
+        """The replica a hedge would duplicate to: the first candidate
+        other than ``via`` whose breaker looks live (read-only check —
+        a duplicate must not consume half-open trial slots)."""
+        for c in candidates:
+            if c == via:
+                continue
+            if (self.registry is None
+                    or self.registry.available(self._breaker_key(c))):
+                return c
+        return None
+
+    def _record(self, target: int, ok: bool) -> None:
+        if self.registry is not None:
+            self.registry.record(self._breaker_key(target), ok)
+
+    def _dispatch_hedged(self, wid: int, via: int, candidates,
+                         queries, diff: str):
+        """One batch through ``via``, hedged: if no answer lands within
+        the shard's adaptive delay (recent latency quantile, floor
+        ``DOS_HEDGE_MIN_MS``) and the hedge budget grants, a duplicate
+        goes to a live replica — first answer wins, the loser's result
+        is discarded (identical rows, deterministic kernels: redundant,
+        never wrong). Raises only when every issued attempt raised.
+
+        Breaker accounting happens PER LANE, by the attempt itself, at
+        the moment that attempt completes — a hedge win must not book a
+        success on the primary's breaker (a wedged primary would then
+        never OPEN and budget-denied batches would keep hanging on it);
+        a loser that eventually times out records its own failure from
+        its background thread."""
+        alt = None
+        if self.hedge.config.enabled and len(candidates) > 1:
+            if self.hedge.would_issue():
+                alt = self._hedge_target(wid, via, candidates)
+            else:
+                # budget spent: this batch could never hedge — book the
+                # denial and stay on the cheap inline path
+                M_BUDGET_DENIED.inc()
+        if alt is None:
+            # unreplicated / hedging off / budget spent: dispatch
+            # inline on the runner thread, exactly the pre-hedging path
+            # (no per-batch thread spawn for batches that could never
+            # hedge anyway)
+            t0 = time.monotonic()
+            try:
+                out = self._answer_once(wid, via, queries, diff)
+            except Exception:
+                self._record(via, False)
+                raise
+            self._record(via, True)
+            self.hedge.observe(wid, time.monotonic() - t0)
+            return out
+        results: _stdqueue.Queue = _stdqueue.Queue()
+
+        def run(target: int, is_hedge: bool) -> None:
+            t0 = time.monotonic()
+            try:
+                r = self._answer_once(wid, target, queries, diff)
+            except Exception as e:  # noqa: BLE001 — collected below
+                self._record(target, False)
+                results.put((is_hedge, None, e, time.monotonic() - t0))
+                return
+            self._record(target, True)
+            results.put((is_hedge, r, None, time.monotonic() - t0))
+
+        threading.Thread(
+            target=run, args=(via, False), daemon=True,
+            name=f"dos-serve-primary-w{wid}").start()
+        inflight = 1
+        try:
+            got = results.get(timeout=self.hedge.delay_s(wid))
+            inflight -= 1
+        except _stdqueue.Empty:
+            got = None
+            if self.hedge.try_issue():
+                log.info("shard w%d batch slow on w%d; hedging to "
+                         "replica w%d", wid, via, alt)
+                threading.Thread(
+                    target=run, args=(alt, True), daemon=True,
+                    name=f"dos-serve-hedge-w{wid}").start()
+                inflight += 1
+        primary_errored = got is not None and got[1] is None
+        while got is None or (got[1] is None and inflight > 0):
+            # no answer yet, or the first completion was an error and
+            # another attempt is still in flight: keep collecting
+            nxt = results.get()
+            inflight -= 1
+            if nxt[1] is None and not nxt[0]:
+                primary_errored = True
+            got = nxt if got is None or got[1] is None else got
+        is_hedge, out, exc, duration = got
+        if out is None:
+            raise exc
+        if is_hedge and not primary_errored:
+            # a WIN is the replica beating a live primary; a hedge that
+            # survived because the primary ERRORED is failover, and
+            # must not inflate the hedge-effectiveness headline
+            M_WON.inc()
+        self.hedge.observe(wid, duration)
+        return out

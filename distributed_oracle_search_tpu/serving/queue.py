@@ -29,13 +29,22 @@ _IDLE_TICK_S = 0.05
 
 
 class ShardQueue:
-    def __init__(self, depth: int):
+    def __init__(self, depth: int, gauge=None):
         if depth <= 0:
             raise ValueError("queue depth must be positive")
         self.depth = int(depth)
+        #: optional per-shard depth gauge (the replicated frontend wires
+        #: one per queue so failover load shifts are visible per shard;
+        #: the aggregate ``serve_queue_depth`` always updates)
+        self._gauge = gauge
         self._q: deque[ServeRequest] = deque()
         self._cond = threading.Condition()
         self._closed = False
+
+    def _book(self, delta: int) -> None:
+        G_DEPTH.add(delta)
+        if self._gauge is not None:
+            self._gauge.add(delta)
 
     def __len__(self) -> int:
         with self._cond:
@@ -53,7 +62,7 @@ class ShardQueue:
                 return False
             req.t_enqueue = time.monotonic()
             self._q.append(req)
-            G_DEPTH.add(1)
+            self._book(1)
             self._cond.notify()
             return True
 
@@ -71,7 +80,7 @@ class ShardQueue:
             out = list(self._q)
             self._q.clear()
             if out:
-                G_DEPTH.add(-len(out))
+                self._book(-len(out))
             return out
 
     def get_batch(self, max_batch: int, max_wait_s: float,
@@ -93,5 +102,5 @@ class ShardQueue:
                 self._cond.wait(min(remaining, _IDLE_TICK_S))
             n = min(max_batch, len(self._q))
             batch = [self._q.popleft() for _ in range(n)]
-            G_DEPTH.add(-n)
+            self._book(-n)
             return batch

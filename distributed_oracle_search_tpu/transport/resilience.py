@@ -50,6 +50,10 @@ M_PROBE_HALF_OPEN = obs_metrics.counter(
     "OPEN->HALF_OPEN transitions (probe success or cooldown lapse)")
 G_OPEN = obs_metrics.gauge(
     "head_circuits_open", "breakers currently OPEN or HALF_OPEN")
+M_FAILOVER = obs_metrics.counter(
+    "failover_total",
+    "batches re-routed from a dead/failed primary to a live replica "
+    "(head campaign path and serving frontend both book here)")
 
 
 class CircuitBreaker:
@@ -117,6 +121,17 @@ class CircuitBreaker:
                 M_OPENED.inc()
                 G_OPEN.add(1)
 
+    def would_allow(self) -> bool:
+        """Read-only: could a send plausibly be admitted right now?
+        Unlike :meth:`allow` this neither consumes the half-open trial
+        slot nor books a rejection — the replicated frontend uses it to
+        pick admission/hedge targets without disturbing the breaker's
+        state machine."""
+        with self._lock:
+            if self.state == OPEN:
+                return self.clock() - self.opened_at >= self.cooldown_s
+            return True
+
     def half_open(self, why: str = "probe") -> None:
         with self._lock:
             if self.state == OPEN:
@@ -171,6 +186,15 @@ class BreakerRegistry:
     def allow(self, key) -> bool:
         return self.get(key).allow() if self.enabled else True
 
+    def available(self, key) -> bool:
+        """Read-only :meth:`CircuitBreaker.would_allow` (no breaker is
+        created for an unseen key — unseen means healthy)."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            br = self._breakers.get(key)
+        return br is None or br.would_allow()
+
     def record(self, key, ok: bool) -> None:
         if not self.enabled:
             return
@@ -220,3 +244,42 @@ class BreakerRegistry:
                               "consecutive_failures":
                                   b.consecutive_failures}
                     for k, b in self._breakers.items()}
+
+
+def send_failover(candidates, send_fn, registry=None):
+    """Walk a shard's replica chain until one worker answers.
+
+    ``candidates`` is the failover order (primary first) of breaker
+    keys; ``send_fn(key)`` attempts one candidate and returns an object
+    with an ``ok`` attribute (a :class:`~.wire.StatsRow` on the
+    campaign path). A candidate whose breaker is OPEN is skipped
+    without a send — the short-circuit that makes a dead primary cost
+    nothing per batch — and every attempted candidate's outcome is
+    recorded on its own breaker, so replica health is tracked
+    independently of primary health.
+
+    Any dispatch to a non-primary candidate books ``failover_total``
+    once per batch. Returns ``(row, served_key, reasons)``: ``row`` is
+    the last attempt's result (or None when every candidate was
+    short-circuited), ``served_key`` the candidate that answered OK (or
+    None), and ``reasons`` the per-candidate failure list
+    ``[(key, "circuit-open" | "send-failed"), ...]``.
+    """
+    reasons: list = []
+    row = None
+    failed_over = False
+    for key in candidates:
+        if registry is not None and not registry.allow(key):
+            reasons.append((key, "circuit-open"))
+            continue
+        if reasons and not failed_over:
+            # first dispatch off the primary: this batch failed over
+            failed_over = True
+            M_FAILOVER.inc()
+        row = send_fn(key)
+        if registry is not None:
+            registry.record(key, row.ok)
+        if row.ok:
+            return row, key, reasons
+        reasons.append((key, "send-failed"))
+    return row, None, reasons

@@ -48,6 +48,11 @@ class ClusterConfig:
     diffs: list[str] = dataclasses.field(default_factory=lambda: ["-"])
     nfs: str = "/tmp"
     projectdir: str = "."
+    #: R-way shard replication (host/serving modes): replica rank r of
+    #: worker w's rows also lives on worker (w + r) % maxworker, giving
+    #: the head failover targets and the frontend hedge targets. 1 =
+    #: no replication (today's behavior). ``DOS_REPLICATION`` overrides.
+    replication: int = 1
     # TPU-mode extensions (ignored by host mode)
     mesh_shape: Sequence[int] | None = None
     mesh_axes: Sequence[str] | None = None
@@ -74,7 +79,29 @@ class ClusterConfig:
         elif self.partmethod in ("div", "mod"):
             if not isinstance(self.partkey, int) or self.partkey <= 0:
                 raise ValueError(f"{self.partmethod} needs a positive int partkey")
+        if (not isinstance(self.replication, int)
+                or not 1 <= self.replication <= self.maxworker):
+            raise ValueError(
+                f"replication must be an int in [1, maxworker="
+                f"{self.maxworker}], got {self.replication!r}")
         return self
+
+    def effective_replication(self) -> int:
+        """The conf's replication with the ``DOS_REPLICATION`` env
+        override applied (env policy: a malformed or out-of-range value
+        degrades to the conf's, never crashes)."""
+        from .env import env_cast
+        from .log import get_logger
+
+        r = env_cast("DOS_REPLICATION", None, int)
+        if r is None:
+            return self.replication
+        if not 1 <= r <= self.maxworker:
+            get_logger(__name__).warning(
+                "ignoring DOS_REPLICATION=%d outside [1, maxworker=%d]; "
+                "using %d", r, self.maxworker, self.replication)
+            return self.replication
+        return r
 
     @property
     def is_tpu(self) -> bool:
@@ -83,6 +110,8 @@ class ClusterConfig:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d = {k: v for k, v in d.items() if v is not None}
+        if d.get("replication") == 1:
+            del d["replication"]      # R=1 confs stay byte-identical
         return d
 
     @classmethod

@@ -61,6 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rebuild every block from scratch (default: "
                         "resume — skip blocks the build ledger records "
                         "as complete with a matching on-disk digest)")
+    p.add_argument("--replication", type=int, default=None,
+                   help="R-way shard replication: after the primary "
+                        "rows, also build this worker's hosted replica "
+                        "block sets (rank r of shard (wid - r) %% W; "
+                        "copied from digest-valid primaries when "
+                        "sharing a filesystem, recomputed otherwise). "
+                        "Default: DOS_REPLICATION or 1")
     p.add_argument("--metrics-dump", default="",
                    help="write a JSON obs-metrics snapshot here on exit "
                         "(build_blocks_resumed_total etc.)")
@@ -74,18 +81,44 @@ def main(argv=None) -> int:
     outdir = args.outdir or os.path.dirname(os.path.abspath(args.input))
     partkey = args.partkey if args.partmethod == "alloc" else args.partkey[0]
 
+    from ..utils.env import env_cast
+
+    replication = args.replication
+    if replication is None:
+        replication = env_cast("DOS_REPLICATION", 1, int)
+    if not 1 <= replication <= args.maxworker:
+        # env policy: degrade, don't crash — and match the head, which
+        # ignores an out-of-range DOS_REPLICATION the same way
+        # (ClusterConfig.effective_replication)
+        log.warning("ignoring replication=%d outside [1, maxworker=%d]"
+                    "; building primaries only", replication,
+                    args.maxworker)
+        replication = 1
     graph = Graph.from_xy(args.input)
     dc_kw = ({"block_size": args.block_size} if args.block_size > 0
              else {})
     dc = DistributionController(args.partmethod, partkey, args.maxworker,
-                                graph.n, **dc_kw)
+                                graph.n, replication=replication,
+                                **dc_kw)
     written = build_worker_shard(graph, dc, args.workerid, outdir,
                                  chunk=args.chunk,
                                  resume=not args.no_resume,
                                  method=args.method)
-    log.info("worker %d: wrote %d block(s) to %s",
-             args.workerid, len(written), outdir)
-    print(f"worker {args.workerid}: {len(written)} block(s) -> {outdir}")
+    n_replica = 0
+    if dc.replication > 1:
+        from ..models.cpd import build_replica_shards
+
+        replica_written = build_replica_shards(
+            graph, dc, args.workerid, outdir, chunk=args.chunk,
+            resume=not args.no_resume, method=args.method)
+        n_replica = sum(len(v) for v in replica_written.values())
+    log.info("worker %d: wrote %d primary block(s)%s to %s",
+             args.workerid, len(written),
+             f" + {n_replica} replica block(s)" if n_replica else "",
+             outdir)
+    print(f"worker {args.workerid}: {len(written)} block(s)"
+          + (f" + {n_replica} replica block(s)" if dc.replication > 1
+             else "") + f" -> {outdir}")
     if args.metrics_dump:
         from ..obs import metrics as obs_metrics
 

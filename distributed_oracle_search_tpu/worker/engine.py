@@ -62,7 +62,7 @@ M_DUPS = obs_metrics.counter(
 
 
 def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
-                    heal: bool = True) -> np.ndarray:
+                    heal: bool = True, replica: int = 0) -> np.ndarray:
     """Load one worker's CPD rows from the block files the builder wrote
     (``cpd-w<wid>-b<bid>.npy``; the index manifest is optional so a shard
     can serve before the whole cluster's build completes).
@@ -71,10 +71,18 @@ def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
     the rows load; a corrupt/torn block is quarantined and — when the
     caller supplies ``graph`` and ``dc`` (``ShardEngine`` does) —
     rebuilt in place, else the load fails with the per-block diagnostic
-    instead of serving garbage answers."""
+    instead of serving garbage answers.
+
+    ``replica``: load shard ``wid``'s rank-``replica`` REPLICA block set
+    (``cpd-w<wid>-r<r>-b<bid>.npy``) — the failover copy a non-primary
+    host serves from. When no replica blocks exist but the primary set
+    shares this filesystem (the common shared-nfs deployment), the load
+    falls back to the primary files: the rows are identical by
+    construction, and a failover must not die on a missing copy of data
+    that is sitting right there."""
     from ..models.cpd import (
         M_BLOCKS_CORRUPT, M_BLOCKS_VERIFIED, check_manifest_version,
-        heal_block, load_verified_block, read_manifest,
+        heal_block, load_verified_block, read_manifest, shard_block_name,
     )
 
     manifest: dict | None = None
@@ -87,15 +95,24 @@ def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
         # entries must not be misread into mass quarantine/rebuild
         check_manifest_version(manifest, outdir)
     blocks_meta = (manifest or {}).get("blocks", {})
-    pat = os.path.join(outdir, f"cpd-w{wid:05d}-b*.npy")
+    # name prefix up to the block id: primary names must NOT match
+    # replica entries of the same shard (and vice versa)
+    prefix = shard_block_name(wid, 0, replica)[:-len("00000.npy")]
+    pat = os.path.join(outdir, f"{prefix}*.npy")
     files = sorted(glob.glob(pat),
                    key=lambda p: int(re.search(r"-b(\d+)\.npy$", p).group(1)))
     # the manifest knows blocks the glob cannot see (deleted on disk)
     manifested = sorted(
         (os.path.join(outdir, f) for f in blocks_meta
-         if f.startswith(f"cpd-w{wid:05d}-")),
+         if f.startswith(prefix)),
         key=lambda p: int(re.search(r"-b(\d+)\.npy$", p).group(1)))
     files = manifested if manifested else files
+    if not files and replica:
+        log.warning("no rank-%d replica blocks for shard %d in %s; "
+                    "falling back to the primary block set (same rows, "
+                    "shared filesystem)", replica, wid, outdir)
+        return load_shard_rows(outdir, wid, dc=dc, graph=graph,
+                               heal=heal)
     if not files:
         raise FileNotFoundError(f"no CPD blocks for worker {wid} in {outdir}")
     parts = []
@@ -125,7 +142,8 @@ def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
 
 class ShardEngine:
     def __init__(self, graph: Graph, dc: DistributionController, wid: int,
-                 outdir: str, alg: str = "table-search"):
+                 outdir: str, alg: str = "table-search",
+                 shard: int | None = None):
         import jax.numpy as jnp
         from ..ops import DeviceGraph
 
@@ -135,18 +153,26 @@ class ShardEngine:
         self.graph = graph
         self.dc = dc
         self.wid = wid
+        #: the SHARD whose rows this engine answers — ``wid`` itself for
+        #: a primary engine, another shard when this worker serves a
+        #: replica (failover/hedge target). The rows load from the
+        #: matching replica block set.
+        self.shard = wid if shard is None else int(shard)
+        self.replica = (dc.replica_rank(self.shard, wid)
+                        if self.shard != wid else 0)
         #: device-batch rows per A* chunk; the deadline is checked
         #: between chunks (first chunk always runs)
         self.astar_chunk = 1024
         if alg == "table-search":  # astar needs no first-move shard
-            self.fm = jnp.asarray(load_shard_rows(outdir, wid, dc=dc,
-                                                  graph=graph))
-            owned = dc.owned(wid)
+            self.fm = jnp.asarray(load_shard_rows(
+                outdir, self.shard, dc=dc, graph=graph,
+                replica=self.replica))
+            owned = dc.owned(self.shard)
             if len(owned) != self.fm.shape[0]:
                 raise ValueError(
-                    f"shard w{wid}: {self.fm.shape[0]} CPD rows but "
-                    f"controller owns {len(owned)} nodes — partition "
-                    "mismatch")
+                    f"shard w{self.shard}: {self.fm.shape[0]} CPD rows "
+                    f"but controller owns {len(owned)} nodes — "
+                    "partition mismatch")
         else:
             self.fm = None
         self.dg = DeviceGraph.from_graph(graph)
@@ -203,11 +229,11 @@ class ShardEngine:
         # opaque index/shape error out of owned_index_of or the kernel
         if len(queries):
             owner = self.dc.worker_of(queries[:, 1])
-            if (owner != self.wid).any():
-                bad = int((owner != self.wid).sum())
+            if (owner != self.shard).any():
+                bad = int((owner != self.shard).sum())
                 raise ValueError(
-                    f"shard w{self.wid} received {bad} queries for other "
-                    "workers — routing invariant violated")
+                    f"shard w{self.shard} received {bad} queries for "
+                    "other workers — routing invariant violated")
         with obs_trace.span("worker.weights", wid=self.wid,
                             difffile=difffile):
             w_pad = self._weights_for(difffile, config.no_cache)

@@ -71,6 +71,10 @@ M_PING_DROPS = obs_metrics.counter(
     "server_ping_replies_dropped_total",
     "health replies dropped (prober gone) — kept separate from "
     "server_replies_dropped_total so data-plane drop alerts stay clean")
+M_REPLICA_BATCHES = obs_metrics.counter(
+    "server_replica_batches_total",
+    "batches answered from a hosted REPLICA shard (failover/hedge "
+    "traffic re-routed off the shard's primary)")
 
 
 class FifoServer:
@@ -79,15 +83,44 @@ class FifoServer:
                  alg: str = "table-search"):
         self.conf = conf
         self.wid = wid
+        self.alg = alg
         self.command_fifo = command_fifo or command_fifo_path(wid)
-        graph = Graph.from_xy(conf.xy_file)
-        dc = DistributionController(conf.partmethod, conf.partkey,
-                                    conf.maxworker, graph.n)
-        self.engine = ShardEngine(graph, dc, wid, conf.outdir, alg=alg)
+        self.graph = Graph.from_xy(conf.xy_file)
+        self.dc = DistributionController(
+            conf.partmethod, conf.partkey, conf.maxworker, self.graph.n,
+            replication=conf.effective_replication())
+        self.engine = ShardEngine(self.graph, self.dc, wid, conf.outdir,
+                                  alg=alg)
+        #: lazily-loaded engines for the REPLICA shards this worker
+        #: hosts (rank 1..R-1): failover traffic pays the replica load
+        #: on first use, never at startup
+        self._replica_engines: dict[int, ShardEngine] = {
+            wid: self.engine}
         # preload the first diff's weights like the reference server does
         # (make_fifos.py:18 loads only diffs[0])
         if conf.diffs:
             self.engine._weights_for(conf.diffs[0], no_cache=False)
+
+    def engine_for_shard(self, shard: int) -> ShardEngine:
+        """The engine serving ``shard``'s rows — the primary engine for
+        our own shard, a lazily-created replica engine for shards whose
+        replica this worker hosts, and a routing-invariant error for
+        anything else (the engine's own check would catch it, but this
+        diagnostic names the replica map)."""
+        eng = self._replica_engines.get(shard)
+        if eng is None:
+            if shard not in self.dc.replica_shards(self.wid):
+                raise ValueError(
+                    f"worker {self.wid} hosts no replica of shard "
+                    f"{shard} (hosted: {self.dc.replica_shards(self.wid)})"
+                    " — routing invariant violated")
+            log.info("worker %d: loading replica of shard %d for "
+                     "failover traffic", self.wid, shard)
+            eng = ShardEngine(self.graph, self.dc, self.wid,
+                              self.conf.outdir, alg=self.alg,
+                              shard=shard)
+            self._replica_engines[shard] = eng
+        return eng
 
     # ------------------------------------------------------------ serving
     def _ensure_fifo(self) -> None:
@@ -116,13 +149,24 @@ class FifoServer:
         with obs_trace.span("worker.receive", wid=self.wid,
                             queryfile=req.queryfile):
             queries = read_query_file(req.queryfile)
-        cost, plen, fin, stats = self.engine.answer(queries, req.config,
-                                                    req.difffile)
-        if self.engine.last_paths is not None:
+        engine = self.engine
+        if self.dc.replication > 1 and len(queries):
+            # replica-aware dispatch: a failover/hedge batch targets a
+            # shard we host as a replica — serve it from that replica's
+            # engine instead of failing the primary's routing
+            # invariant. (R=1 skips the ownership scan: the engine's
+            # own routing check covers misroutes.)
+            shards = np.unique(self.dc.worker_of(queries[:, 1]))
+            if len(shards) == 1 and int(shards[0]) != self.wid:
+                engine = self.engine_for_shard(int(shards[0]))
+                M_REPLICA_BATCHES.inc()
+        cost, plen, fin, stats = engine.answer(queries, req.config,
+                                               req.difffile)
+        if engine.last_paths is not None:
             # extraction rides the shared dir, not the stats FIFO (wire
             # extension: transport.wire.paths_file_for)
             write_paths_file(paths_file_for(req.queryfile),
-                             *self.engine.last_paths)
+                             *engine.last_paths)
         if req.config.results:
             # per-query answers for the online serving frontend — same
             # shared-dir sidecar pattern as .paths (wire extension:

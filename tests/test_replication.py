@@ -1,0 +1,730 @@
+"""Shard replication: placement, wire round-trip, replica builds +
+anti-entropy, failover routing, and hedged dispatch.
+
+Tier-1 gates: R=1 stays byte-identical to the unreplicated system
+(placement, wire format, routing); an R=2 serve world with one breaker
+forced open answers every request via failover (zero degraded); a
+campaign with a crashed primary exits 0 with ``failover_total > 0`` and
+answer columns identical to a fault-free run; hedges win under an
+injected delay within the configured rate budget. The mid-run
+kill-the-primary chaos drill stays behind ``slow``.
+"""
+
+import csv
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.cli import process_query as pq
+from distributed_oracle_search_tpu.cli.gen_distribute_conf import (
+    main as gen_conf_main,
+)
+from distributed_oracle_search_tpu.data import (
+    ensure_synth_dataset, read_scen,
+)
+from distributed_oracle_search_tpu.data.graph import Graph
+from distributed_oracle_search_tpu.models.cpd import (
+    anti_entropy, build_replica_shards, read_manifest, shard_block_name,
+    verify_exit_code, verify_index, write_index_manifest,
+)
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController, UNROUTABLE, parse_conf,
+)
+from distributed_oracle_search_tpu.serving import (
+    EngineDispatcher, HedgeConfig, HedgeTracker, ServeConfig,
+    ServingFrontend,
+)
+from distributed_oracle_search_tpu.testing import faults
+from distributed_oracle_search_tpu.transport import resilience
+from distributed_oracle_search_tpu.transport.wire import RuntimeConfig
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker import FifoServer, stop_server
+from distributed_oracle_search_tpu.worker.build import main as build_main
+from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+pytestmark = pytest.mark.replication
+
+N_WORKERS = 3
+
+
+def _counter(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot()["counters"].get(name, 0)
+
+
+# ------------------------------------------------------------ placement
+
+def test_replica_placement_distinct_workers():
+    dc = DistributionController("mod", 5, 5, 100, replication=3)
+    for wid in range(5):
+        hosts = dc.replica_workers(wid)
+        assert hosts[0] == wid                      # rank 0 = primary
+        assert len(set(hosts)) == 3                 # distinct workers
+        for r, h in enumerate(hosts):
+            assert dc.replica_rank(wid, h) == r
+        # replica_shards is the exact inverse
+        for h in hosts:
+            assert wid in dc.replica_shards(h)
+
+
+def test_replication_one_is_identity():
+    dc = DistributionController("mod", 4, 4, 64)
+    assert dc.replication == 1
+    assert dc.replica_workers(2) == [2]
+    assert dc.replica_shards(2) == [2]
+    with pytest.raises(ValueError):
+        dc.replica_rank(2, 3)
+
+
+def test_replication_bounds_validated():
+    with pytest.raises(ValueError):
+        DistributionController("mod", 4, 4, 64, replication=5)
+    with pytest.raises(ValueError):
+        DistributionController("mod", 4, 4, 64, replication=0)
+
+
+# ------------------------------------------------------- wire round-trip
+
+def test_format_conf_r1_byte_identical_legacy():
+    """The R=1 wire format must not move: legacy consumers parse by
+    position."""
+    dc = DistributionController("mod", 4, 4, 12, block_size=2)
+    lines = dc.format_conf().split("\n")
+    assert lines[0] == "node,wid,bid,bidx"
+    assert all(len(ln.split(",")) == 4 for ln in lines[1:])
+
+
+def test_parse_format_round_trip_replicated():
+    dc = DistributionController("mod", 4, 4, 32, block_size=4,
+                                replication=3)
+    p = parse_conf(dc.format_conf())
+    assert p["replication"] == 3
+    tab = dc.table()
+    np.testing.assert_array_equal(p["node"], tab[:, 0])
+    np.testing.assert_array_equal(p["wid"], tab[:, 1])
+    np.testing.assert_array_equal(p["bid"], tab[:, 2])
+    np.testing.assert_array_equal(p["bidx"], tab[:, 3])
+    np.testing.assert_array_equal(p["replicas"], dc.replica_table())
+
+
+def test_parse_conf_legacy_and_unknown_columns():
+    # legacy 4-column format -> replication 1, no replica columns
+    legacy = "node,wid,bid,bidx\n0,0,0,0\n1,1,0,0"
+    p = parse_conf(legacy)
+    assert p["replication"] == 1 and p["replicas"].shape == (2, 0)
+    # unknown columns are tolerated wherever they appear (compat
+    # contract shared with the wire codecs)
+    future = ("node,wid,future_key,bid,bidx,rep1,another\n"
+              "0,0,99,0,0,1,7\n1,1,99,0,0,2,7")
+    p2 = parse_conf(future)
+    assert p2["replication"] == 2
+    np.testing.assert_array_equal(p2["replicas"][:, 0], [1, 2])
+    np.testing.assert_array_equal(p2["bid"], [0, 0])
+    with pytest.raises(ValueError):
+        parse_conf("node,wid,bid\n0,0,0")            # missing bidx
+
+
+def test_gen_distribute_conf_cli_emits_replica_table(capsys):
+    gen_conf_main(["--nodenum", "8", "--maxworker", "4",
+                   "--partmethod", "mod", "--partkey", "4",
+                   "--replication", "2"])
+    out = capsys.readouterr().out
+    p = parse_conf(out)
+    assert p["replication"] == 2
+    np.testing.assert_array_equal(
+        p["replicas"][:, 0], (np.arange(8) % 4 + 1) % 4)
+
+
+# --------------------------------------------------- replica-aware routing
+
+def test_group_queries_r1_byte_identical():
+    """Pinned: with no dead set, routing is identical whatever R is —
+    and identical to the pre-replication controller."""
+    rng = np.random.default_rng(7)
+    qs = rng.integers(0, 100, size=(50, 2))
+    base = DistributionController("mod", 4, 4, 100)
+    repl = DistributionController("mod", 4, 4, 100, replication=3)
+    g1, g2 = base.group_queries(qs), repl.group_queries(qs)
+    assert list(g1) == list(g2)
+    for wid in g1:
+        np.testing.assert_array_equal(g1[wid], g2[wid])
+
+
+def test_group_queries_routes_around_dead_workers():
+    dc = DistributionController("mod", 4, 4, 100, replication=2)
+    qs = np.stack([np.zeros(100, np.int64), np.arange(100)], axis=1)
+    groups = dc.group_queries(qs, dead={1})
+    assert 1 not in groups
+    # shard 1's queries moved to its rank-1 replica host (worker 2)
+    moved = groups[2]
+    assert (dc.worker_of(moved[:, 1]) != 2).any()   # some are shard 1's
+    total = sum(len(p) for p in groups.values())
+    assert total == len(qs)                          # nothing dropped
+
+
+def test_group_queries_all_replicas_dead_is_unroutable():
+    """All replicas of a node dead => the query comes back in the
+    UNROUTABLE bucket immediately — never silently dropped, never
+    routed to a dead worker (the caller sheds it UNAVAILABLE)."""
+    dc = DistributionController("mod", 4, 4, 100, replication=2)
+    qs = np.array([[0, 1], [0, 2]])     # targets owned by shards 1, 2
+    groups = dc.group_queries(qs, dead={1, 2})
+    assert UNROUTABLE in groups
+    np.testing.assert_array_equal(groups[UNROUTABLE], [[0, 1]])
+    np.testing.assert_array_equal(groups[3], [[0, 2]])  # 2's replica
+
+
+def test_group_queries_active_worker_with_replicas():
+    dc = DistributionController("mod", 4, 4, 100, replication=2)
+    qs = np.stack([np.zeros(16, np.int64), np.arange(16)], axis=1)
+    # restricting to worker 2 keeps what ROUTES to 2: its own shard
+    # plus shard 1's failover traffic when 1 is dead
+    only2 = dc.group_queries(qs, active_worker=2)
+    assert list(only2) == [2]
+    only2_dead = dc.group_queries(qs, active_worker=2, dead={1})
+    assert set(only2_dead) == {2}
+    assert len(only2_dead[2]) == len(only2[2]) + 4   # + shard 1's
+
+
+# -------------------------------------------------------- build fixtures
+
+@pytest.fixture(scope="module")
+def repl_world(tmp_path_factory):
+    """3-worker world with a replicated (R=2) CPD index: primary block
+    sets + replica block sets + a manifest recording both."""
+    datadir = str(tmp_path_factory.mktemp("repl-data"))
+    paths = ensure_synth_dataset(datadir, width=8, height=6,
+                                 n_queries=45, seed=23)
+    outdir = os.path.join(datadir, "index")
+    for wid in range(N_WORKERS):
+        build_main(["--input", paths["xy"], "--partmethod", "mod",
+                    "--partkey", str(N_WORKERS), "--workerid", str(wid),
+                    "--maxworker", str(N_WORKERS), "--outdir", outdir,
+                    "--replication", "2"])
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", N_WORKERS, N_WORKERS, g.n,
+                                replication=2)
+    write_index_manifest(outdir, dc)
+    return datadir, paths, outdir, g, dc
+
+
+def _repl_conf(repl_world, name, diffs):
+    datadir, paths, outdir, g, dc = repl_world
+    conf = ClusterConfig(
+        workers=["localhost"] * N_WORKERS,
+        partmethod="mod", partkey=N_WORKERS,
+        outdir=outdir, xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=diffs, nfs=datadir, replication=2,
+    ).validate()
+    path = os.path.join(datadir, name)
+    conf.save(path)
+    return conf, path
+
+
+# ------------------------------------------------- build + anti-entropy
+
+def test_replicated_manifest_and_digests(repl_world):
+    datadir, paths, outdir, g, dc = repl_world
+    man = read_manifest(outdir)
+    assert man["replication"] == 2
+    assert len(man["replica_files"]) == N_WORKERS
+    for rf in man["replica_files"]:
+        prim = rf.replace("-r01", "")
+        assert prim in man["files"]
+        # a copied/recomputed replica is bit-identical to its primary
+        assert man["blocks"][rf]["digest"] == man["blocks"][prim]["digest"]
+    # verify covers replicas too
+    rep = verify_index(outdir, dc=dc)
+    assert rep["total"] == 2 * N_WORKERS
+    assert rep["ok"] == 2 * N_WORKERS
+    assert verify_exit_code(rep) == 0
+
+
+def test_anti_entropy_detects_and_heals(repl_world):
+    datadir, paths, outdir, g, dc = repl_world
+    clean = anti_entropy(outdir, dc, graph=g)
+    assert clean["checked"] == N_WORKERS and not clean["mismatched"]
+    man = read_manifest(outdir)
+    victim = man["replica_files"][0]
+    with open(os.path.join(outdir, victim), "r+b") as f:
+        f.seek(96)
+        f.write(b"\x7f" * 8)
+    m0 = _counter("replica_digest_mismatches_total")
+    report = anti_entropy(outdir, dc, graph=g, manifest=man)
+    assert [e["file"] for e in report["mismatched"]] == [victim]
+    assert report["healed"] == [victim]
+    assert _counter("replica_digest_mismatches_total") - m0 == 1
+    # healed in place: a second pass is clean, and the digest matches
+    # the primary again
+    again = anti_entropy(outdir, dc, graph=g)
+    assert not again["mismatched"]
+    man2 = read_manifest(outdir)
+    assert (man2["blocks"][victim]["digest"]
+            == man2["blocks"][victim.replace("-r01", "")]["digest"])
+
+
+def test_missing_replica_set_materializes_by_copy(repl_world, tmp_path):
+    """build_replica_shards on an index with only primaries copies the
+    digest-valid primary bytes instead of recomputing."""
+    datadir, paths, outdir, g, dc = repl_world
+    alt = str(tmp_path / "prim-only")
+    os.makedirs(alt)
+    import shutil
+    for wid in range(N_WORKERS):
+        fname = shard_block_name(wid, 0)
+        shutil.copy(os.path.join(outdir, fname), os.path.join(alt, fname))
+    c0 = _counter("replica_blocks_copied_total")
+    out = build_replica_shards(g, dc, 2, alt)
+    # worker 2 hosts the rank-1 replica of shard 1
+    assert out == {1: [shard_block_name(1, 0, 1)]}
+    assert _counter("replica_blocks_copied_total") - c0 == 1
+    assert os.path.exists(os.path.join(alt, shard_block_name(1, 0, 1)))
+
+
+# ------------------------------------------------- engines and servers
+
+def test_replica_engine_answers_identical(repl_world):
+    datadir, paths, outdir, g, dc = repl_world
+    qs = read_scen(paths["scen"])
+    shard1 = qs[dc.worker_of(qs[:, 1]) == 1]
+    prim = ShardEngine(g, dc, 1, outdir)
+    repl = ShardEngine(g, dc, 2, outdir, shard=1)     # host 2, shard 1
+    assert repl.shard == 1 and repl.replica == 1
+    c_a, p_a, f_a, _ = prim.answer(shard1, RuntimeConfig())
+    c_b, p_b, f_b, _ = repl.answer(shard1, RuntimeConfig())
+    np.testing.assert_array_equal(c_a, c_b)
+    np.testing.assert_array_equal(p_a, p_b)
+    np.testing.assert_array_equal(f_a, f_b)
+    # a replica engine still enforces ITS shard's routing invariant
+    other = qs[dc.worker_of(qs[:, 1]) == 0][:2]
+    with pytest.raises(ValueError, match="routing invariant"):
+        repl.answer(other, RuntimeConfig())
+
+
+def test_fifo_server_serves_hosted_replica_batch(repl_world, tmp_path):
+    """A worker's server answers a batch targeting a shard it hosts as
+    a replica (the wire half of failover), and books the replica
+    counter; a batch for an un-hosted shard still fails loudly."""
+    datadir, paths, outdir, g, dc = repl_world
+    conf, _ = _repl_conf(repl_world, "conf-server.json", ["-"])
+    server = FifoServer(conf, 2,
+                        command_fifo=str(tmp_path / "w2.fifo"))
+    qs = read_scen(paths["scen"])
+    shard1 = qs[dc.worker_of(qs[:, 1]) == 1][:6]
+    from distributed_oracle_search_tpu.transport.wire import (
+        Request, write_query_file,
+    )
+    qfile = str(tmp_path / "query.test")
+    write_query_file(qfile, shard1)
+    r0 = _counter("server_replica_batches_total")
+    row = server._handle(Request(RuntimeConfig(), qfile,
+                                 str(tmp_path / "ans"), "-"))
+    assert row.finished == len(shard1)
+    assert _counter("server_replica_batches_total") - r0 == 1
+    # shard 0 is NOT hosted by worker 2 at R=2 (hosted: {2, 1})
+    shard0 = qs[dc.worker_of(qs[:, 1]) == 0][:2]
+    write_query_file(qfile, shard0)
+    with pytest.raises(ValueError, match="routing invariant"):
+        server._handle(Request(RuntimeConfig(), qfile,
+                               str(tmp_path / "ans"), "-"))
+
+
+# ---------------------------------------------------- serve: failover
+
+def test_serve_failover_smoke_zero_degraded(repl_world):
+    """The tier-1 replication smoke: R=2 in-process serving with the
+    primary's breaker forced open — every request is answered via the
+    replica (zero degraded answers), failover_total moves."""
+    datadir, paths, outdir, g, dc = repl_world
+    conf, _ = _repl_conf(repl_world, "conf-serve.json", ["-"])
+    dispatcher = EngineDispatcher(conf, graph=g, dc=dc)
+    registry = resilience.BreakerRegistry(threshold=1, cooldown_s=600.0,
+                                          enabled=True)
+    registry.record(0, ok=False)               # shard 0's primary: OPEN
+    f0 = _counter("failover_total")
+    fe = ServingFrontend(dc, dispatcher,
+                         sconf=ServeConfig(max_wait_ms=2.0,
+                                           cache_bytes=0),
+                         registry=registry,
+                         hconf=HedgeConfig(enabled=False))
+    fe.start()
+    try:
+        qs = read_scen(paths["scen"])
+        shard0 = qs[dc.worker_of(qs[:, 1]) == 0][:8]
+        res = [fe.query(int(s), int(t), timeout=60) for s, t in shard0]
+        assert all(r.ok for r in res), [r.status for r in res]
+        # answers match the primary engine's (replica rows identical)
+        c, p, f, _ = dispatcher._engine_for(0).answer(
+            shard0, RuntimeConfig())
+        assert [r.cost for r in res] == c.tolist()
+        assert [r.plen for r in res] == p.tolist()
+    finally:
+        fe.stop()
+        registry.shutdown()
+    assert _counter("failover_total") - f0 > 0
+
+
+def test_serve_all_replicas_dead_sheds_unavailable(repl_world):
+    """All replicas of the target shard breaker-dead => immediate
+    UNAVAILABLE at admission, not a hang or a deadline'd timeout."""
+    datadir, paths, outdir, g, dc = repl_world
+    conf, _ = _repl_conf(repl_world, "conf-dead.json", ["-"])
+    registry = resilience.BreakerRegistry(threshold=1, cooldown_s=600.0,
+                                          enabled=True)
+    registry.record(0, ok=False)     # shard 0's primary
+    registry.record(1, ok=False)     # shard 0's rank-1 replica host
+    fe = ServingFrontend(dc, EngineDispatcher(conf, graph=g, dc=dc),
+                         sconf=ServeConfig(cache_bytes=0),
+                         registry=registry,
+                         hconf=HedgeConfig(enabled=False))
+    fe.start()
+    try:
+        qs = read_scen(paths["scen"])
+        s, t = (int(v) for v in qs[dc.worker_of(qs[:, 1]) == 0][0])
+        t0 = time.monotonic()
+        res = fe.query(s, t, timeout=5)
+        assert res.status == "UNAVAILABLE"
+        assert res.detail == "no-live-replica"
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        fe.stop()
+        registry.shutdown()
+
+
+def test_engine_dispatcher_builds_missing_replica_lazily(tmp_path):
+    """Satellite: a bare --test-style world needs no pre-build step —
+    the dispatcher materializes missing primary AND replica shards on
+    first use."""
+    datadir = str(tmp_path / "lazy")
+    paths = ensure_synth_dataset(datadir, width=8, height=6,
+                                 n_queries=16, seed=9)
+    conf = ClusterConfig(
+        workers=["localhost"] * 2, partmethod="mod", partkey=2,
+        outdir=os.path.join(datadir, "idx"), xy_file=paths["xy"],
+        scenfile=paths["scen"], nfs=datadir, replication=2,
+    ).validate()
+    g = Graph.from_xy(conf.xy_file)
+    dc = DistributionController("mod", 2, 2, g.n, replication=2)
+    disp = EngineDispatcher(conf, graph=g, dc=dc, build_missing=True)
+    qs = read_scen(conf.scenfile)
+    shard1 = qs[dc.worker_of(qs[:, 1]) == 1][:4]
+    # replica route first: nothing on disk, so the replica block set of
+    # shard 1 (hosted by worker 0) is built lazily
+    c, p, f = disp.answer_batch(1, shard1, RuntimeConfig(), "-", via=0)
+    assert os.path.exists(os.path.join(
+        conf.outdir, shard_block_name(1, 0, 1)))
+    c2, p2, f2 = disp.answer_batch(1, shard1, RuntimeConfig(), "-")
+    np.testing.assert_array_equal(c, c2)
+    np.testing.assert_array_equal(p, p2)
+
+
+# ------------------------------------------------------- serve: hedging
+
+class _SlowVia:
+    """Via-aware dispatcher wrapper: dispatches through ``slow_wid``
+    sleep ``delay_s`` before answering (the injected `delay` fault's
+    in-process analog)."""
+
+    def __init__(self, inner, slow_wid, delay_s):
+        self.inner = inner
+        self.slow_wid = slow_wid
+        self.delay_s = delay_s
+
+    def answer_batch(self, wid, queries, rconf, diff, via=None):
+        v = wid if via is None else via
+        if v == self.slow_wid:
+            time.sleep(self.delay_s)
+        return self.inner.answer_batch(wid, queries, rconf, diff,
+                                       via=via)
+
+
+def test_hedge_wins_under_delay_within_budget(repl_world):
+    """Serve smoke: a slow primary loses to the hedge (hedges_won > 0)
+    and the hedge rate stays within the configured budget."""
+    datadir, paths, outdir, g, dc = repl_world
+    conf, _ = _repl_conf(repl_world, "conf-hedge.json", ["-"])
+    inner = EngineDispatcher(conf, graph=g, dc=dc)
+    qs = read_scen(paths["scen"])
+    shard0 = qs[dc.worker_of(qs[:, 1]) == 0][:8]
+    # warm both engines off the clock (first-call JIT must not count
+    # as "slow primary")
+    inner.answer_batch(0, shard0, RuntimeConfig(), "-")
+    inner.answer_batch(0, shard0, RuntimeConfig(), "-", via=1)
+    hconf = HedgeConfig(enabled=True, min_delay_ms=25.0, budget=1.0)
+    i0, w0 = (_counter("hedges_issued_total"),
+              _counter("hedges_won_total"))
+    fe = ServingFrontend(dc, _SlowVia(inner, 0, 0.4),
+                         sconf=ServeConfig(max_wait_ms=1.0,
+                                           cache_bytes=0, max_batch=8),
+                         hconf=hconf)
+    fe.start()
+    try:
+        res = [fe.query(int(s), int(t), timeout=60) for s, t in shard0]
+        assert all(r.ok for r in res), [r.status for r in res]
+    finally:
+        fe.stop()
+    issued = _counter("hedges_issued_total") - i0
+    assert issued > 0
+    assert _counter("hedges_won_total") - w0 > 0
+    assert fe.hedge.hedge_rate() <= hconf.budget + 1e-9
+    time.sleep(0.5)          # let loser primary threads drain
+
+
+def test_hedge_budget_caps_rate(repl_world):
+    datadir, paths, outdir, g, dc = repl_world
+    conf, _ = _repl_conf(repl_world, "conf-budget.json", ["-"])
+    inner = EngineDispatcher(conf, graph=g, dc=dc)
+    qs = read_scen(paths["scen"])
+    shard0 = qs[dc.worker_of(qs[:, 1]) == 0][:12]
+    inner.answer_batch(0, shard0, RuntimeConfig(), "-")
+    inner.answer_batch(0, shard0, RuntimeConfig(), "-", via=1)
+    hconf = HedgeConfig(enabled=True, min_delay_ms=10.0, budget=0.25)
+    d0 = _counter("hedges_budget_denied_total")
+    fe = ServingFrontend(dc, _SlowVia(inner, 0, 0.2),
+                         sconf=ServeConfig(max_wait_ms=1.0,
+                                           cache_bytes=0, max_batch=1),
+                         hconf=hconf)
+    fe.start()
+    try:
+        futs = []
+        for s, t in shard0:           # one at a time: many batches
+            futs.append(fe.submit(int(s), int(t)))
+        res = [f.result(60) for f in futs]
+        assert all(r.ok for r in res)
+    finally:
+        fe.stop()
+    tr = fe.hedge
+    # the budget held: hedges <= grace + budget * dispatches
+    assert tr._hedges <= tr.BUDGET_GRACE + hconf.budget * tr._dispatches
+    assert _counter("hedges_budget_denied_total") - d0 > 0
+    time.sleep(0.5)
+
+
+class _FailingPrimary:
+    """Via-aware dispatcher: the primary lane of ``bad_wid`` raises
+    after ``delay_s`` (a wedged-then-erroring worker); replicas answer
+    instantly."""
+
+    def __init__(self, inner, bad_wid, delay_s):
+        self.inner = inner
+        self.bad_wid = bad_wid
+        self.delay_s = delay_s
+
+    def answer_batch(self, wid, queries, rconf, diff, via=None):
+        v = wid if via is None else via
+        if v == self.bad_wid:
+            time.sleep(self.delay_s)
+            raise RuntimeError("primary wedged")
+        return self.inner.answer_batch(wid, queries, rconf, diff,
+                                       via=via)
+
+
+def test_hedge_win_still_opens_wedged_primary_breaker(repl_world):
+    """A hedge win must NOT book a breaker success for the primary
+    lane: the losing primary's own (eventual) failure records on ITS
+    breaker, which OPENs after the threshold — so later batches stop
+    waiting on the wedged worker instead of hedging forever."""
+    datadir, paths, outdir, g, dc = repl_world
+    conf, _ = _repl_conf(repl_world, "conf-wedge.json", ["-"])
+    inner = EngineDispatcher(conf, graph=g, dc=dc)
+    qs = read_scen(paths["scen"])
+    shard0 = qs[dc.worker_of(qs[:, 1]) == 0][:6]
+    inner.answer_batch(0, shard0, RuntimeConfig(), "-", via=1)  # warm
+    registry = resilience.BreakerRegistry(threshold=2, cooldown_s=600.0,
+                                          enabled=True)
+    fe = ServingFrontend(dc, _FailingPrimary(inner, 0, 0.05),
+                         sconf=ServeConfig(max_wait_ms=1.0,
+                                           cache_bytes=0, max_batch=2),
+                         registry=registry,
+                         hconf=HedgeConfig(enabled=True,
+                                           min_delay_ms=10.0,
+                                           budget=1.0))
+    fe.start()
+    try:
+        res = [fe.query(int(s), int(t), timeout=60) for s, t in shard0]
+        assert all(r.ok for r in res)          # hedges answered them
+        time.sleep(0.5)                        # losers record failures
+        assert registry.get(0).state == resilience.OPEN, \
+            "wedged primary's breaker never opened"
+        # with the breaker OPEN the next batch skips the primary
+        # entirely (failover, no hedge wait) and still answers
+        s, t = (int(v) for v in shard0[0])
+        assert fe.query(s, t, timeout=60).ok
+    finally:
+        fe.stop()
+        registry.shutdown()
+    time.sleep(0.3)
+
+
+def test_hedge_tracker_adaptive_delay():
+    tr = HedgeTracker(HedgeConfig(min_delay_ms=5.0, quantile=0.5))
+    assert tr.delay_s(0) == pytest.approx(0.005)     # cold: the floor
+    for v in (0.01, 0.02, 0.03, 0.04, 0.05, 0.06, 0.07, 0.08):
+        tr.observe(0, v)
+    # median of the window, floored
+    assert tr.delay_s(0) == pytest.approx(0.04)
+    assert tr.delay_s(1) == pytest.approx(0.005)     # other shard: cold
+
+
+# -------------------------------------------------- campaign: failover
+
+def _thread_servers(conf, fifo_dir, monkeypatch):
+    os.makedirs(fifo_dir, exist_ok=True)
+    fifos = {wid: os.path.join(fifo_dir, f"worker{wid}.fifo")
+             for wid in range(conf.maxworker)}
+    monkeypatch.setattr(pq, "command_fifo_path", lambda wid: fifos[wid])
+    servers = [FifoServer(conf, wid, command_fifo=fifos[wid])
+               for wid in range(conf.maxworker)]
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in servers]
+    for t in threads:
+        t.start()
+    for fifo in fifos.values():
+        for _ in range(100):
+            if os.path.exists(fifo):
+                break
+            time.sleep(0.02)
+    return fifos, threads
+
+
+def _stop_all(fifos, threads):
+    for fifo in fifos.values():
+        stop_server(fifo, deadline_s=5.0)
+    for t in threads:
+        t.join(timeout=15)
+
+
+def _answer_columns(outdir):
+    """parts.csv minus the timing columns — the deterministic answer
+    payload of a campaign."""
+    with open(os.path.join(outdir, "parts.csv")) as fh:
+        rows = list(csv.reader(fh))
+    hdr = rows[0]
+    keep = [hdr.index(k) for k in
+            ("expe", "n_expanded", "n_touched", "plen", "finished",
+             "size")]
+    return [[r[i] for i in keep] for r in rows[1:]]
+
+
+def test_campaign_failover_clean_exit(repl_world, tmp_path,
+                                      monkeypatch):
+    """A campaign whose worker-1 engine crashes on every batch still
+    exits 0: each shard-1 batch fails over to worker 2's replica, no
+    degraded.json, answers bit-identical to a fault-free run."""
+    datadir = repl_world[0]
+    conf, conf_path = _repl_conf(repl_world, "conf-campaign.json",
+                                 ["-", "-"])
+    monkeypatch.setenv("DOS_RETRY_MAX", "0")
+    # fault-free golden run
+    faults.reset()
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+    fifos, threads = _thread_servers(conf, str(tmp_path / "f0"),
+                                     monkeypatch)
+    out0 = str(tmp_path / "artifacts-clean")
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host", "-o", out0])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN
+
+    # faulted run: worker 1's engine crashes every batch
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "crash-engine;wid=1;times=inf")
+    f0 = _counter("failover_total")
+    fifos, threads = _thread_servers(conf, str(tmp_path / "f1"),
+                                     monkeypatch)
+    out1 = str(tmp_path / "artifacts-faulted")
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host", "-o", out1])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN                       # exit 0, not 3
+    assert not os.path.exists(os.path.join(out1, "degraded.json"))
+    assert _counter("failover_total") - f0 >= 2      # both rounds
+    assert _answer_columns(out0) == _answer_columns(out1)
+
+
+def test_campaign_all_replicas_down_books_degraded(repl_world,
+                                                   tmp_path,
+                                                   monkeypatch):
+    """When a shard's primary AND replica both fail, the batch books
+    degraded with the replica trail recorded — failover widens
+    availability, it never hides a real loss."""
+    datadir = repl_world[0]
+    conf, conf_path = _repl_conf(repl_world, "conf-bothdown.json", ["-"])
+    faults.reset()
+    # shard 1's primary (w1) and its replica host (w2) both crash
+    monkeypatch.setenv("DOS_FAULTS",
+                       "crash-engine;wid=1;times=inf,"
+                       "crash-engine;wid=2;times=inf")
+    monkeypatch.setenv("DOS_RETRY_MAX", "0")
+    fifos, threads = _thread_servers(conf, str(tmp_path / "fifos"),
+                                     monkeypatch)
+    out = str(tmp_path / "artifacts")
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host", "-o", out])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_DEGRADED
+    man = json.load(open(os.path.join(out, "degraded.json")))
+    # shard 2's batch failed over to worker 0 and survived; shards 1
+    # and 2 both crashed as PRIMARIES, but only shard 1 lost both
+    # replicas (w1 + w2); shard 2's replica is healthy w0
+    assert man["failed_workers"] == [1]
+    trail = man["failed_batches"][0]["replicas_tried"]
+    assert [e["wid"] for e in trail] == [1, 2]
+    assert all(e["reason"] == "send-failed" for e in trail)
+
+
+# ------------------------------------------------------ the chaos drill
+
+@pytest.mark.slow
+def test_chaos_kill_primary_mid_campaign(repl_world, tmp_path,
+                                         monkeypatch):
+    """The acceptance drill: worker 1's server process dies MID-RUN
+    (kill-mid-batch after it already served round 0). The campaign
+    completes clean — exit 0, failover_total > 0, zero degraded
+    entries — and every answer column is bit-identical to a fault-free
+    run."""
+    datadir = repl_world[0]
+    conf, conf_path = _repl_conf(repl_world, "conf-chaos.json",
+                                 ["-", "-", "-"])
+    monkeypatch.setenv("DOS_RETRY_MAX", "0")
+    monkeypatch.setenv("DOS_SEND_TIMEOUT_S", "15")
+
+    faults.reset()
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+    fifos, threads = _thread_servers(conf, str(tmp_path / "f0"),
+                                     monkeypatch)
+    out0 = str(tmp_path / "clean")
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host", "-o", out0])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN
+
+    faults.reset()
+    # the in-thread analog of a hard crash: the server thread reads
+    # round 1's request for worker 1 and dies (mode=raise returns from
+    # the serve loop, tearing down its command FIFO like a dead
+    # process's would be); the head's send times out, the next rounds
+    # fail fast on the missing FIFO, and every shard-1 batch from
+    # round 1 on fails over to worker 2's replica
+    monkeypatch.setenv("DOS_FAULTS",
+                       "kill-mid-batch;wid=1;mode=raise;after=1")
+    f0 = _counter("failover_total")
+    fifos, threads = _thread_servers(conf, str(tmp_path / "f1"),
+                                     monkeypatch)
+    out1 = str(tmp_path / "chaos")
+    try:
+        rc = pq.main(["-c", conf_path, "--backend", "host", "-o", out1])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN, "campaign must survive the kill"
+    assert not os.path.exists(os.path.join(out1, "degraded.json"))
+    assert _counter("failover_total") - f0 >= 1
+    assert _answer_columns(out0) == _answer_columns(out1)
