@@ -590,6 +590,26 @@ def main() -> None:
         f"{issued_gather / 1e6:,.0f} ({issued_gather / peak_gather:.0%}), "
         f"issue efficiency {achieved_gather / issued_gather:.0%}; "
         f"HBM {hbm_bw / 1e9:,.0f} GB/s")
+    # XLA's own accounting of the SAME program (obs.device): FLOPs /
+    # bytes-accessed / HBM footprint per compiled program, plus the
+    # derived achieved-vs-peak gather-bandwidth point — the before/after
+    # baseline ROADMAP item 1 (Pallas walk kernel) is judged against
+    from distributed_oracle_search_tpu.obs import device as obs_device
+    walk_costs = obs_device.analyze(
+        kern_fn, oracle.dg, oracle.fm, ra_d, sa_d, ta_d, va_d,
+        oracle.dg.w_pad)
+    if walk_costs:
+        if "bytes_accessed" in walk_costs:
+            gbps = walk_costs["bytes_accessed"] / t_kern_s / 1e9
+            walk_costs["achieved_gbps"] = round(gbps, 2)
+            walk_costs["hbm_bw_utilization"] = round(
+                gbps / (hbm_bw / 1e9), 4)
+            log(f"roofline (XLA): {walk_costs.get('flops', 0):,.0f} "
+                f"FLOPs, {walk_costs['bytes_accessed'] / 1e6:,.1f} MB "
+                f"accessed -> {gbps:,.1f} GB/s achieved "
+                f"({walk_costs['hbm_bw_utilization']:.0%} of the "
+                f"streamed-HBM peak)")
+        obs_device.record("walk-kernel", walk_costs)
 
     # ---- measured CPU denominator: the SAME graph + scenario through the
     # native OpenMP engine (full build + resident fifo_auto campaign over
@@ -1758,6 +1778,15 @@ def main() -> None:
             "walk_issue_efficiency": round(
                 achieved_gather / issued_gather, 3),
             "hbm_stream_gbps": round(hbm_bw / 1e9, 1),
+            # XLA cost/memory analysis of the walk program + the derived
+            # achieved-vs-peak gather-bandwidth figure (obs.device)
+            **({"walk_flops": walk_costs.get("flops"),
+                "walk_bytes_accessed": walk_costs.get("bytes_accessed"),
+                "walk_hbm_bytes": walk_costs.get("hbm_bytes"),
+                "walk_achieved_gbps": walk_costs.get("achieved_gbps"),
+                "walk_hbm_bw_utilization":
+                    walk_costs.get("hbm_bw_utilization")}
+               if walk_costs else {}),
         },
         **scale_stats,
         **road_stats,
@@ -1772,6 +1801,10 @@ def main() -> None:
     # exercised (detail file only — the stdout line stays compact)
     from distributed_oracle_search_tpu.obs import metrics as obs_metrics
     detail["obs"] = obs_metrics.REGISTRY.snapshot()
+    # per-program-key XLA cost/memory analyses accumulated by every
+    # engine this run compiled programs in (obs.device): FLOPs, bytes
+    # accessed, HBM footprint per (alg, shape, knobs) key
+    detail["device_costs"] = obs_device.snapshot()
     payload = {
         "metric": "scenario_queries_per_sec",
         "value": round(qps, 1),

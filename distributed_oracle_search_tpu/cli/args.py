@@ -180,6 +180,11 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                      help="Write a JSON snapshot of the obs.metrics "
                           "registry (counters / gauges / histograms) to "
                           "this path at campaign end.")
+    obs.add_argument("--obs-port", type=int, default=None,
+                     help="Serve live /metrics /healthz /statusz scrape "
+                          "endpoints on this port for the process's "
+                          "lifetime (0 = OS-assigned; default off; "
+                          "DOS_OBS_PORT env).")
     return p
 
 

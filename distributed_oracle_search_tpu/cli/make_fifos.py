@@ -95,7 +95,9 @@ def main(argv=None) -> int:
             raise SystemExit(f"--supervise is local-only; conf names "
                              f"remote hosts {sorted(set(remote))} — run "
                              f"the supervisor on each worker host")
-        return supervise_forever(conf, conf_path, alg=args.alg)
+        return supervise_forever(conf, conf_path, alg=args.alg,
+                                 obs_port=getattr(args, "obs_port",
+                                                  None))
     procs = []
     for wid in range(conf.maxworker):
         if args.worker != -1 and wid != args.worker:

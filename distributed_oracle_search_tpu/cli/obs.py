@@ -1,0 +1,179 @@
+"""Fleet observability CLI (``dos-obs``).
+
+Head-side tooling over the artifacts and endpoints the obs plane
+produces (the merge/compare logic lives in :mod:`..obs.fleet`):
+
+* ``dos-obs merge-metrics [-o fleet_metrics.json] SNAPSHOT...`` —
+  merge per-worker ``obs_metrics.json`` snapshots (shipped over the
+  NFS data plane by ``--metrics-dump`` / campaign artifact dirs) into
+  one labeled fleet document: per-worker sections plus summed fleet
+  counters/gauges/histograms. ``--label`` overrides the path-derived
+  worker labels (repeatable, positional order).
+* ``dos-obs merge-traces -o merged.json TRACE_OR_DIR...`` — merge a
+  campaign head's ``--trace`` file with worker ``.trace`` span
+  sidecars (directories are globbed for ``*.trace``) into ONE
+  Perfetto-loadable timeline.
+* ``dos-obs top --endpoints host:port[,host:port...]`` — poll each
+  endpoint's ``/statusz`` and render the live fleet table (queue
+  depths, open breakers, hedge rate, worker batches/failures);
+  ``--watch N`` refreshes every N seconds until interrupted.
+* ``dos-obs bench-diff [--dir .]`` — compare the newest
+  ``BENCH_r*.json`` against the previous one with per-key tolerances
+  (``--tolerance``, ``--key-tolerance key=frac``) and exit non-zero on
+  regression — the bench trajectory as a CI gate instead of a log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from ..obs import fleet
+from ..utils.log import get_logger, set_verbosity
+
+log = get_logger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dos-obs", description=__doc__.splitlines()[0])
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    mm = sub.add_parser("merge-metrics",
+                        help="merge per-worker obs_metrics.json "
+                             "snapshots into fleet_metrics.json")
+    mm.add_argument("snapshots", nargs="+", help="snapshot JSON paths")
+    mm.add_argument("-o", "--output", default="fleet_metrics.json")
+    mm.add_argument("--label", action="append", default=[],
+                    help="worker label per positional snapshot "
+                         "(default: derived from the path)")
+
+    mt = sub.add_parser("merge-traces",
+                        help="merge head trace + worker .trace "
+                             "sidecars into one Perfetto timeline")
+    mt.add_argument("traces", nargs="+",
+                    help="trace files, sidecars, or dirs (globs "
+                         "*.trace)")
+    mt.add_argument("-o", "--output", required=True)
+
+    tp = sub.add_parser("top", help="live fleet table from /statusz")
+    tp.add_argument("--endpoints", required=True,
+                    help="comma-separated host:port list")
+    tp.add_argument("--watch", type=float, default=0.0,
+                    help="refresh every N seconds (0 = once)")
+    tp.add_argument("--timeout", type=float, default=3.0)
+
+    bd = sub.add_parser("bench-diff",
+                        help="gate the newest BENCH_r*.json against "
+                             "the previous round")
+    bd.add_argument("records", nargs="*",
+                    help="explicit OLD NEW record paths (default: the "
+                         "two newest in --dir)")
+    bd.add_argument("--dir", default=".",
+                    help="where BENCH_r*.json live")
+    bd.add_argument("--tolerance", type=float,
+                    default=fleet.DEFAULT_TOLERANCE,
+                    help="allowed fractional slack per key")
+    bd.add_argument("--key-tolerance", action="append", default=[],
+                    metavar="KEY=FRAC",
+                    help="per-key tolerance override (repeatable)")
+    return p
+
+
+def _cmd_merge_metrics(args) -> int:
+    inputs = fleet.load_snapshot_files(args.snapshots,
+                                       labels=args.label)
+    doc = fleet.merge_snapshots(inputs)
+    from ..utils.atomicio import atomic_write_bytes
+    atomic_write_bytes(args.output,
+                       (json.dumps(doc, indent=1) + "\n").encode())
+    print(f"merged {doc['n_workers']} snapshot(s) -> {args.output}")
+    return 0
+
+
+def _cmd_merge_traces(args) -> int:
+    n = fleet.merge_traces(args.traces, args.output)
+    print(f"merged {n} event(s) -> {args.output} "
+          "(open at https://ui.perfetto.dev)")
+    return 0
+
+
+def _cmd_top(args) -> int:
+    endpoints = [e.strip() for e in args.endpoints.split(",")
+                 if e.strip()]
+    try:
+        while True:
+            # Ctrl-C must exit cleanly from ANYWHERE in the refresh —
+            # the polls themselves block up to timeout_s per
+            # unreachable endpoint, not just the sleep
+            statuses = {ep: fleet.fetch_statusz(ep,
+                                                timeout_s=args.timeout)
+                        for ep in endpoints}
+            print(fleet.render_top(statuses))
+            if args.watch <= 0:
+                return 0
+            time.sleep(args.watch)
+            print()
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_bench_diff(args) -> int:
+    if args.records:
+        if len(args.records) != 2:
+            raise SystemExit("bench-diff takes exactly OLD NEW when "
+                             "records are given explicitly")
+        old_path, new_path = args.records
+    else:
+        records = fleet.find_bench_records(args.dir)
+        if len(records) < 2:
+            print(f"bench-diff: fewer than two BENCH_r*.json in "
+                  f"{args.dir}; nothing to compare")
+            return 0
+        new_path = records[-1]
+        # compare against the nearest PREVIOUS round that actually
+        # carries numbers: an unparseable record (the r04 overflow
+        # failure mode) must not mask a regression by matching nothing
+        old_path = next(
+            (p for p in reversed(records[:-1]) if fleet.bench_numbers(p)),
+            records[-2])
+    key_tol = {}
+    for spec in args.key_tolerance:
+        key, _, frac = spec.partition("=")
+        try:
+            key_tol[key] = float(frac)
+        except ValueError:
+            raise SystemExit(f"bad --key-tolerance {spec!r} "
+                             "(want KEY=FRACTION)")
+    out = fleet.compare_bench(old_path, new_path,
+                              tolerance=args.tolerance,
+                              key_tolerances=key_tol)
+    print(f"bench-diff: {out['old']} -> {out['new']} "
+          f"({out['checked']} shared keys)")
+    for e in out["improved"]:
+        print(f"  + {e['key']}: {e['old']:g} -> {e['new']:g} "
+              f"(x{e['ratio']:.2f})")
+    for e in out["regressions"]:
+        print(f"  ! REGRESSION {e['key']}: {e['old']:g} -> "
+              f"{e['new']:g} (x{e['ratio']:.2f}, "
+              f"{e['direction']}-is-better, tol {e['tolerance']:.0%})")
+    if out["regressions"]:
+        return 1
+    print("  no regressions")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    set_verbosity(args.verbose)
+    return {"merge-metrics": _cmd_merge_metrics,
+            "merge-traces": _cmd_merge_traces,
+            "top": _cmd_top,
+            "bench-diff": _cmd_bench_diff}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

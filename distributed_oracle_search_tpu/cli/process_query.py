@@ -847,24 +847,34 @@ def main(argv=None) -> int:
     if getattr(args, "trace", ""):
         obs_trace.enable()
         obs_trace.set_trace_id(obs_trace.new_trace_id())
+    # live scrape endpoints for the campaign's lifetime (opt-in): a
+    # long road-scale campaign is observable while it runs, not only
+    # from its exit artifacts
+    from ..obs.http import start_obs_server
+    obs_srv = start_obs_server(getattr(args, "obs_port", None))
     import contextlib
     if args.profile:
         import jax
         trace = jax.profiler.trace(args.profile)
     else:
         trace = contextlib.nullcontext()
-    with trace:
-        if args.test:
-            data, stats = test(args)
+    try:
+        with trace:
+            if args.test:
+                data, stats = test(args)
+                _finish_obs(args)
+                return campaign_exit_code(data, stats)
+            conf = ClusterConfig.load(args.c)
+            data, stats, paths = run(conf, args)
+            # multi-controller: every process runs the identical
+            # campaign; only process 0 writes/prints the shared
+            # artifacts
+            if is_primary():
+                output(data, stats, args, paths)
             _finish_obs(args)
-            return campaign_exit_code(data, stats)
-        conf = ClusterConfig.load(args.c)
-        data, stats, paths = run(conf, args)
-        # multi-controller: every process runs the identical campaign;
-        # only process 0 writes/prints the shared artifacts
-        if is_primary():
-            output(data, stats, args, paths)
-        _finish_obs(args)
+    finally:
+        if obs_srv is not None:
+            obs_srv.close()
     code = campaign_exit_code(data, stats)
     if code != EXIT_CLEAN:
         log.error("campaign finished %s (exit %d): %d/%d batches failed%s",

@@ -88,6 +88,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-request deadline (DOS_SERVE_DEADLINE_MS)")
     p.add_argument("--metrics-dump", default="",
                    help="write a JSON metrics snapshot here on shutdown")
+    p.add_argument("--obs-port", type=int, default=None,
+                   help="serve live /metrics /healthz /statusz on this "
+                        "port (0 = OS-assigned ephemeral; default off; "
+                        "DOS_OBS_PORT env)")
     return p
 
 
@@ -150,6 +154,7 @@ def main(argv=None) -> int:
         conf = ClusterConfig.load(args.c)
     frontend, registry = build_frontend(conf, args)
     frontend.start()
+    obs_srv = None
     # graceful drain: SIGTERM (the orchestrator's stop signal) and
     # SIGINT both stop ingress — the event ends the socket/tail loops,
     # the exception unwinds a blocking stdin read — then the finally
@@ -169,6 +174,23 @@ def main(argv=None) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, _on_signal)
     try:
+        # live observability plane (opt-in): /metrics answers Prometheus
+        # text with the sliding-window p50/p95/p99 gauges + exemplars,
+        # /healthz flips 503 once draining starts, /statusz reports
+        # breaker + queue + replica + hedge state. Inside the try: a
+        # bind failure (port taken) must drain the started frontend,
+        # not leave its batcher threads running behind a traceback
+        from ..obs import device as obs_device
+        from ..obs.http import start_obs_server
+        obs_srv = start_obs_server(
+            args.obs_port,
+            health_fn=lambda: {
+                "ok": frontend._started and not frontend._closed,
+                "role": "dos-serve", "backend": args.backend},
+            status_providers={
+                "serving": frontend.statusz,
+                "device_programs": obs_device.snapshot,
+            })
         if args.ingress == "stdin":
             n = ingress.serve_stdin(frontend)
         elif args.ingress == "socket":
@@ -186,6 +208,8 @@ def main(argv=None) -> int:
     finally:
         stop_evt.set()
         frontend.stop()
+        if obs_srv is not None:
+            obs_srv.close()
         if registry is not None:
             registry.shutdown()
         if args.metrics_dump:

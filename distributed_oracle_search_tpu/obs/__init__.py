@@ -7,11 +7,35 @@ every perf/robustness change reports through:
 
 * :mod:`.metrics` — thread-safe counters / gauges / histograms with JSON
   snapshot and Prometheus text exposition (``--metrics-dump PATH``, and
-  ``bench.py`` embeds a snapshot in ``BENCH_DETAIL.json``);
+  ``bench.py`` embeds a snapshot in ``BENCH_DETAIL.json``); per-worker
+  name suffixes (``serve_queue_depth_w3``) fold into
+  ``{worker="3"}`` labels on the text exposition;
 * :mod:`.trace` — nested span tracing exporting Chrome trace-event JSON
   (``--trace PATH``, open in Perfetto), with a per-batch ``trace_id``
   propagated head→worker as a ``RuntimeConfig`` wire extension so both
-  sides of one batch join on a single timeline.
+  sides of one batch join on a single timeline;
+* :mod:`.quantiles` — live sliding-window p50/p95/p99 over the last N
+  seconds (``DOS_OBS_WINDOW_S``) for the latency histograms that matter
+  online (``serve_request_seconds``, ``serve_dispatch_seconds``,
+  ``worker_search_seconds``), each window keeping a worst-case
+  **exemplar** ``trace_id`` that links a bad p99 to its Perfetto
+  timeline;
+* :mod:`.http` — the stdlib scrape server every resident process opts
+  into with ``--obs-port`` / ``DOS_OBS_PORT``: ``/metrics`` (Prometheus
+  text incl. live quantiles + per-program XLA costs), ``/healthz``
+  (200/503 with ``HealthStatus`` semantics), ``/statusz`` (JSON:
+  breakers, queue depths, replica/failover map, hedge rates, ledger
+  progress);
+* :mod:`.fleet` — head-side aggregation behind the ``dos-obs`` CLI:
+  merge per-worker ``obs_metrics.json`` into ``fleet_metrics.json``,
+  merge head + worker ``.trace`` sidecars into one campaign-wide
+  Perfetto timeline, poll ``/statusz`` for a live fleet table, and
+  gate ``BENCH_r*.json`` rounds against each other (``bench-diff``);
+* :mod:`.device` — per-compiled-program XLA ``cost_analysis`` /
+  ``memory_analysis`` capture (FLOPs, bytes accessed, HBM footprint)
+  keyed by the engine's program cache, feeding the ``/metrics``
+  ``device_program_*`` gauges and the roofline fields in
+  ``BENCH_DETAIL.json``.
 
 Mapping to the reference paper's per-batch stats fields (the wire CSV,
 ``transport.wire.ENGINE_STAT_FIELDS``) — the histograms decompose what
@@ -116,11 +140,33 @@ dispatch, replica anti-entropy; README "Replication & failover"):
   whose crc32 diverged from their primary's; quarantined + healed),
   ``replica_blocks_copied_total`` (replica blocks materialized by
   copying a digest-valid primary instead of recomputing).
+
+Live observability plane (this PR's standing layer — the scrape-time
+series every resident process exposes):
+
+* scrape endpoints — ``obs_scrapes_total`` (requests answered by
+  ``/metrics`` / ``/healthz`` / ``/statusz``);
+* live quantiles (``obs.quantiles``, window gauges on ``/metrics``
+  only, not in JSON snapshots) —
+  ``serve_request_seconds_window{quantile=...}`` with
+  ``serve_request_seconds_window_worst{trace_id=...}`` exemplar,
+  likewise for ``serve_dispatch_seconds`` and
+  ``worker_search_seconds``;
+* per-worker labels — ``serve_queue_depth{worker="N"}`` is the text-
+  exposition form of the flat ``serve_queue_depth_w<N>`` gauges (JSON
+  snapshots keep the flat names);
+* XLA program costs (``obs.device``) — ``device_programs_analyzed``
+  (gauge) plus per-program ``device_program_flops`` /
+  ``device_program_bytes_accessed`` / ``device_program_hbm_bytes``
+  labeled gauges, captured once per engine program-cache key and
+  embedded in ``BENCH_DETAIL.json`` as the roofline denominators.
 """
 
-from . import metrics, trace
+from . import device, fleet, metrics, quantiles, trace
 from .metrics import REGISTRY, counter, gauge, histogram
+from .quantiles import WINDOWS
 from .trace import span
 
-__all__ = ["metrics", "trace", "REGISTRY", "counter", "gauge",
-           "histogram", "span"]
+__all__ = ["device", "fleet", "metrics", "quantiles", "trace",
+           "REGISTRY", "WINDOWS", "counter", "gauge", "histogram",
+           "span"]

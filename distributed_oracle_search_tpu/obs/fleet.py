@@ -1,0 +1,315 @@
+"""Fleet-wide aggregation: merge worker snapshots, traces, statusz.
+
+One process's registry answers for one process; a campaign or serving
+deployment is a *fleet* — a head plus N workers (plus replicas), each
+already materializing ``obs_metrics.json`` snapshots and ``.trace``
+span sidecars over the shared NFS data plane. This module is the
+head-side merge logic behind the ``dos-obs`` CLI (``cli.obs``):
+
+* :func:`merge_snapshots` — N labeled per-process snapshots into one
+  ``fleet_metrics.json``: counters and histograms sum (bucket-wise —
+  every process runs the same code, so bucket edges agree; a
+  mismatched histogram degrades to count+sum), gauges sum with the
+  per-worker values preserved under ``workers`` so a fleet total never
+  hides a skewed replica. Duplicate labels are disambiguated
+  (``w0``, ``w0#2``) rather than silently overwritten — two workers
+  claiming one identity is exactly the kind of thing a merge must
+  surface.
+* :func:`merge_traces` — head trace files (``{"traceEvents": ...}``)
+  and worker span sidecars (bare event lists) into ONE Perfetto-
+  loadable timeline; events keep their pids so every process is its
+  own track, and batches still join across tracks on ``trace_id``.
+* :func:`fetch_statusz` / :func:`render_top` — poll live ``/statusz``
+  endpoints (``obs.http``) and render the fleet table ``dos-obs top``
+  shows: queue depths, open breakers, hedge rate, replica map per
+  endpoint.
+* :func:`compare_bench` — the regression gate behind ``dos-obs
+  bench-diff``: newest ``BENCH_r*.json`` vs the previous one with
+  per-key tolerances; throughput-like keys must not fall, latency-like
+  keys must not rise.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import urllib.request
+
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+# ------------------------------------------------------------- snapshots
+
+def _merge_histogram(agg: dict, h: dict) -> dict:
+    """Sum one histogram into the aggregate (cumulative buckets are
+    additive per edge). Mismatched bucket edges — which only happens
+    across code versions — degrade to count+sum."""
+    if not agg:
+        return {"count": h.get("count", 0), "sum": h.get("sum", 0.0),
+                "buckets": dict(h.get("buckets", {}))}
+    agg = {"count": agg.get("count", 0) + h.get("count", 0),
+           "sum": agg.get("sum", 0.0) + h.get("sum", 0.0),
+           "buckets": dict(agg.get("buckets", {}))}
+    mine, theirs = agg["buckets"], h.get("buckets", {})
+    if set(mine) == set(theirs):
+        for le in mine:
+            mine[le] += theirs[le]
+    else:
+        log.warning("histogram bucket edges differ across workers; "
+                    "keeping count+sum only")
+        agg["buckets"] = {}
+    return agg
+
+
+def dedupe_labels(labels: list[str]) -> list[str]:
+    """Disambiguate duplicate worker labels in input order:
+    ``w0, w0 -> w0, w0#2``."""
+    seen: dict[str, int] = {}
+    out = []
+    for lab in labels:
+        n = seen.get(lab, 0) + 1
+        seen[lab] = n
+        out.append(lab if n == 1 else f"{lab}#{n}")
+    return out
+
+
+def merge_snapshots(inputs: list[tuple[str, dict]]) -> dict:
+    """``[(label, snapshot), ...]`` -> the fleet document: per-worker
+    snapshots under ``workers`` (labels deduped), summed counters /
+    gauges / histograms under ``fleet``."""
+    labels = dedupe_labels([lab for lab, _ in inputs])
+    workers = {lab: snap for lab, (_, snap) in zip(labels, inputs)}
+    fleet = {"counters": {}, "gauges": {}, "histograms": {}}
+    for snap in workers.values():
+        for name, v in snap.get("counters", {}).items():
+            fleet["counters"][name] = fleet["counters"].get(name, 0) + v
+        for name, v in snap.get("gauges", {}).items():
+            fleet["gauges"][name] = fleet["gauges"].get(name, 0) + v
+        for name, h in snap.get("histograms", {}).items():
+            fleet["histograms"][name] = _merge_histogram(
+                fleet["histograms"].get(name, {}), h)
+    return {"workers": workers, "fleet": fleet,
+            "n_workers": len(workers)}
+
+
+def load_snapshot_files(paths: list[str],
+                        labels: list[str] | None = None) -> list:
+    """Read snapshot JSONs into ``merge_snapshots`` input. Default
+    labels come from the parent dir + filename, which is how per-worker
+    artifact dirs differ."""
+    out = []
+    for i, p in enumerate(paths):
+        with open(p) as f:
+            snap = json.load(f)
+        if labels and i < len(labels):
+            lab = labels[i]
+        else:
+            lab = os.path.join(os.path.basename(os.path.dirname(p)),
+                               os.path.basename(p))
+        out.append((lab, snap))
+    return out
+
+
+# ---------------------------------------------------------------- traces
+
+def _events_of(path: str) -> list[dict]:
+    """Events from either container format: a full Chrome trace doc
+    (``{"traceEvents": [...]}``) or a bare sidecar list."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        evs = doc.get("traceEvents", [])
+    else:
+        evs = doc
+    if not isinstance(evs, list):
+        raise ValueError(f"{path}: no trace events found")
+    return evs
+
+
+def merge_traces(inputs: list[str], out_path: str) -> int:
+    """Merge trace files/sidecars (directories glob ``*.trace``) into
+    one Perfetto-loadable Chrome trace doc. Returns the event count."""
+    paths = []
+    for p in inputs:
+        if os.path.isdir(p):
+            paths.extend(sorted(glob.glob(os.path.join(p, "*.trace"))))
+        else:
+            paths.append(p)
+    events: list[dict] = []
+    for p in paths:
+        evs = _events_of(p)
+        events.extend(evs)
+        log.info("merge-traces: %s -> %d event(s)", p, len(evs))
+    events.sort(key=lambda e: e.get("ts", 0))
+    from ..utils.atomicio import atomic_write_bytes
+    atomic_write_bytes(out_path, json.dumps(
+        {"traceEvents": events, "displayTimeUnit": "ms"},
+        indent=1).encode())
+    return len(events)
+
+
+# --------------------------------------------------------------- statusz
+
+def fetch_statusz(endpoint: str, timeout_s: float = 3.0) -> dict:
+    """``host:port`` -> its ``/statusz`` JSON (``{"error": ...}`` when
+    unreachable — a dead worker is a row in the fleet table, not a
+    crash of the tool watching for dead workers)."""
+    url = endpoint if "://" in endpoint else f"http://{endpoint}"
+    try:
+        with urllib.request.urlopen(f"{url}/statusz",
+                                    timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _summarize(status: dict) -> dict:
+    """Flatten one endpoint's statusz into the fleet-table columns."""
+    if "error" in status:
+        return {"state": "UNREACHABLE", "detail": status["error"]}
+    out: dict = {"state": "up"}
+    serving = status.get("serving", {})
+    if serving:
+        shards = serving.get("shards", {})
+        out["queued"] = sum(s.get("queue_depth", 0)
+                            for s in shards.values())
+        out["shards"] = len(shards)
+        hedge = serving.get("hedge", {})
+        if hedge:
+            out["hedge_rate"] = hedge.get("rate", 0.0)
+    # the serve frontend nests its breaker section under "serving";
+    # a bare BreakerRegistry provider sits at the top level
+    breakers = (serving.get("breakers") or status.get("breakers")
+                or {}).get("breakers", {})
+    if breakers:
+        out["breakers_open"] = sum(
+            1 for b in breakers.values()
+            if b.get("state") in ("open", "half-open"))
+    worker = status.get("worker", {})
+    if worker:
+        out["batches"] = worker.get("batches", 0)
+        out["failures"] = worker.get("batch_failures", 0)
+    sup = status.get("supervisor", {})
+    if sup:
+        out["alive"] = sup.get("alive", 0)
+        out["respawns"] = sup.get("respawns", 0)
+    return out
+
+
+def render_top(statuses: dict[str, dict]) -> str:
+    """The ``dos-obs top`` fleet table: one row per endpoint, columns
+    unioned across roles (a frontend shows queues/hedges, a worker
+    batches/failures, a supervisor alive/respawns)."""
+    rows = {ep: _summarize(st) for ep, st in statuses.items()}
+    cols = ["endpoint"]
+    for r in rows.values():
+        for k in r:
+            if k not in cols:
+                cols.append(k)
+    table = [cols]
+    for ep, r in rows.items():
+        table.append([ep] + [str(r.get(c, "-")) for c in cols[1:]])
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(cols))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+             for row in table]
+    lines.insert(1, "-" * len(lines[0]))
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ bench gate
+
+#: default fractional tolerance — the README documents ±20% swings on
+#: the tunneled shared device, so the gate trips only on clear breaks
+DEFAULT_TOLERANCE = 0.3
+
+#: key patterns whose value IMPROVES downward (everything else is
+#: treated as higher-is-better throughput/ratio)
+_LOWER_BETTER = re.compile(
+    r"(_ms|_seconds|_s)$|(^|_)p\d+_ms$|break[-_]?even")
+
+
+def find_bench_records(dirname: str) -> list[str]:
+    """``BENCH_r*.json`` sorted by round number."""
+    paths = glob.glob(os.path.join(dirname, "BENCH_r[0-9]*.json"))
+    def _round(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+    return sorted((p for p in paths if _round(p) >= 0), key=_round)
+
+
+def bench_numbers(path: str) -> dict[str, float]:
+    """The comparable scalar metrics of one bench record: the headline
+    value plus every numeric entry of ``parsed.headline`` (the driver's
+    record format; a raw bench payload's top-level ``value``/
+    ``detail`` also works). A record whose ``parsed`` is null (the r04
+    overflow failure mode) falls back to the last JSON object in its
+    stdout ``tail``; records with no numbers at all yield ``{}`` —
+    the CLI then walks further back for a comparable round."""
+    with open(path) as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed") or doc
+    if not isinstance(parsed, dict) or (
+            "parsed" in doc and doc["parsed"] is None):
+        parsed = None
+        tail = doc.get("tail", "")
+        if isinstance(tail, str):
+            start = tail.rfind('\n{"metric"')
+            if start < 0 and tail.startswith('{"metric"'):
+                start = -1      # tail IS the line
+            try:
+                parsed = json.loads(tail[start + 1:])
+            except ValueError:
+                parsed = None
+    if not isinstance(parsed, dict):
+        return {}
+    out: dict[str, float] = {}
+    if isinstance(parsed.get("value"), (int, float)):
+        out[parsed.get("metric", "value")] = float(parsed["value"])
+    headline = parsed.get("headline") or parsed.get("detail") or {}
+    for k, v in headline.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def compare_bench(old_path: str, new_path: str,
+                  tolerance: float = DEFAULT_TOLERANCE,
+                  key_tolerances: dict[str, float] | None = None) -> dict:
+    """Per-key regression check; returns ``{"regressions": [...],
+    "improved": [...], "checked": N, ...}``. A key present only on one
+    side is skipped (workloads grow across rounds; absence is not a
+    regression)."""
+    old = bench_numbers(old_path)
+    new = bench_numbers(new_path)
+    key_tolerances = key_tolerances or {}
+    regressions, improved, checked = [], [], []
+    for key in sorted(set(old) & set(new)):
+        tol = key_tolerances.get(key, tolerance)
+        ov, nv = old[key], new[key]
+        checked.append(key)
+        if ov == 0:
+            continue
+        lower_better = bool(_LOWER_BETTER.search(key))
+        ratio = nv / ov
+        entry = {"key": key, "old": ov, "new": nv,
+                 "ratio": round(ratio, 3), "tolerance": tol,
+                 "direction": "lower" if lower_better else "higher"}
+        if lower_better:
+            if ratio > 1.0 + tol:
+                regressions.append(entry)
+            elif ratio < 1.0:
+                improved.append(entry)
+        else:
+            if ratio < 1.0 - tol:
+                regressions.append(entry)
+            elif ratio > 1.0:
+                improved.append(entry)
+    return {"old": os.path.basename(old_path),
+            "new": os.path.basename(new_path),
+            "checked": len(checked), "regressions": regressions,
+            "improved": improved}

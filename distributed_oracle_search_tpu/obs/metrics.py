@@ -24,6 +24,7 @@ when the failure path never fired.
 from __future__ import annotations
 
 import json
+import re
 import threading
 
 #: default latency buckets (seconds) — tuned for the serve path, where a
@@ -172,31 +173,70 @@ class MetricsRegistry:
         return out
 
     def dump_json(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(self.snapshot(), f, indent=1)
+        """Atomic snapshot write (tmp+fsync+rename): a concurrent
+        scrape, NFS copy, or fleet-aggregation pass never reads a torn
+        JSON file. Lazy import — ``utils.atomicio`` imports this module
+        for its own counters."""
+        from ..utils.atomicio import atomic_write_bytes
+        atomic_write_bytes(
+            path, (json.dumps(self.snapshot(), indent=1) + "\n").encode())
 
     def to_prometheus(self) -> str:
-        """Text exposition format 0.0.4 (``# TYPE`` lines + samples)."""
+        """Text exposition format 0.0.4 (``# TYPE`` lines + samples).
+
+        Dynamically-suffixed per-worker metrics (``serve_queue_depth_w3``
+        — the replicated frontend's per-shard gauges) are folded into
+        proper labels (``serve_queue_depth{worker="3"}``) so a scrape
+        sees one metric family per name instead of unbounded name
+        cardinality; JSON snapshots keep the flat names for backward
+        compatibility."""
         with self._lock:
             metrics = dict(self._metrics)
+        # (family, worker-label) in family order, labeled samples last so
+        # each family's TYPE/HELP is emitted once, before its samples
+        families: dict[str, list] = {}
+        for name, m in metrics.items():
+            fam, labels = name, ""
+            mt = re.fullmatch(r"(.+)_w(\d+)", name)
+            if mt:
+                fam, labels = mt.group(1), f'worker="{mt.group(2)}"'
+            families.setdefault(fam, []).append((labels, m, name))
+        # a fold is only valid within one metric kind: a name that merely
+        # LOOKS per-worker but collides with a different-kinded family
+        # falls back to its flat name
+        for fam in list(families):
+            kinds = {type(m) for _, m, _ in families[fam]}
+            if len(kinds) > 1:
+                members = families.pop(fam)
+                for labels, m, name in members:
+                    families.setdefault(name, []).append(("", m, name))
         lines = []
-        for name, m in sorted(metrics.items()):
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {name} counter")
-                lines.append(f"{name} {m.value}")
-            elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {m.value}")
+        for fam in sorted(families):
+            samples = sorted(families[fam], key=lambda s: s[0])
+            kind = samples[0][1]
+            helps = [m.help for _, m, _ in samples if m.help]
+            if helps:
+                lines.append(f"# HELP {fam} {helps[0]}")
+            if isinstance(kind, Counter):
+                lines.append(f"# TYPE {fam} counter")
+            elif isinstance(kind, Gauge):
+                lines.append(f"# TYPE {fam} gauge")
             else:
-                lines.append(f"# TYPE {name} histogram")
+                lines.append(f"# TYPE {fam} histogram")
+            for labels, m, _name in samples:
+                sfx = f"{{{labels}}}" if labels else ""
+                if isinstance(m, (Counter, Gauge)):
+                    lines.append(f"{fam}{sfx} {m.value}")
+                    continue
+                extra = f",{labels}" if labels else ""
                 d = m.as_dict()
                 for le, c in d["buckets"].items():
-                    lines.append(f'{name}_bucket{{le="{le}"}} {c}')
-                lines.append(f'{name}_bucket{{le="+Inf"}} {d["count"]}')
-                lines.append(f"{name}_sum {d['sum']}")
-                lines.append(f"{name}_count {d['count']}")
+                    lines.append(
+                        f'{fam}_bucket{{le="{le}"{extra}}} {c}')
+                lines.append(
+                    f'{fam}_bucket{{le="+Inf"{extra}}} {d["count"]}')
+                lines.append(f"{fam}_sum{sfx} {d['sum']}")
+                lines.append(f"{fam}_count{sfx} {d['count']}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:

@@ -213,8 +213,11 @@ def trace_sidecar_for(queryfile: str) -> str:
 
 
 def write_events(path: str, evs: list[dict]) -> None:
-    with open(path, "w") as f:
-        json.dump(evs, f)
+    """Atomic sidecar write: the head (or a fleet-aggregation pass)
+    polls for sidecars over NFS and must never ingest a torn JSON list.
+    Lazy import — ``utils.atomicio`` registers its own obs counters."""
+    from ..utils.atomicio import atomic_write_bytes
+    atomic_write_bytes(path, json.dumps(evs).encode())
 
 
 def read_events(path: str) -> list[dict]:
@@ -231,6 +234,6 @@ def write_trace(path: str, extra_events: list[dict] | None = None) -> None:
     evs = events()
     if extra_events:
         evs = evs + list(extra_events)
-    with open(path, "w") as f:
-        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f,
-                  indent=1)
+    from ..utils.atomicio import atomic_write_bytes
+    atomic_write_bytes(path, json.dumps(
+        {"traceEvents": evs, "displayTimeUnit": "ms"}, indent=1).encode())

@@ -23,6 +23,7 @@ observable from day one like the campaign path.
 
 from __future__ import annotations
 
+import dataclasses
 import queue as _stdqueue
 import threading
 import time
@@ -30,6 +31,7 @@ import time
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..obs import quantiles as obs_quantiles
 from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
 from ..transport import resilience
@@ -207,6 +209,43 @@ class ServingFrontend:
             timeout = self.sconf.deadline_s + 30.0
         return self.submit(s, t).result(timeout)
 
+    # ------------------------------------------------------------ statusz
+    def statusz(self) -> dict:
+        """Live serving state for the ``/statusz`` endpoint
+        (``obs.http``): per-shard queue depths and replica/failover
+        chains, breaker states, hedge rate + per-shard hedge delays,
+        cache occupancy — the "which replica is absorbing failover"
+        page a fleet operator reads first."""
+        shards = {}
+        for wid, q in self._queues.items():
+            shards[str(wid)] = {
+                "queue_depth": len(q),
+                "queue_bound": q.depth,
+                "closed": q.closed,
+                "replicas": [int(c)
+                             for c in self.dc.replica_workers(wid)],
+                "hedge_delay_ms": round(
+                    self.hedge.delay_s(wid) * 1e3, 3),
+            }
+        out = {
+            "serving": self._started and not self._closed,
+            "diff": self.diff,
+            "replication": int(self.dc.replication),
+            "shards": shards,
+            "hedge": {
+                "enabled": self.hedge.config.enabled,
+                "rate": round(self.hedge.hedge_rate(), 4),
+                "budget": self.hedge.config.budget,
+            },
+            "cache": {
+                "entries": len(self.cache),
+                "max_bytes": self.cache.max_bytes,
+            },
+        }
+        if self.registry is not None:
+            out["breakers"] = self.registry.statusz()
+        return out
+
     def set_diff(self, diff: str) -> None:
         """Switch the active congestion diff. The cache is invalidated
         wholesale: keys carry the diff so stale entries could never be
@@ -226,13 +265,24 @@ class ServingFrontend:
         # p50/p99 IMPROVE exactly when the service is overloaded
         if res.status == OK:
             H_E2E.observe(res.t_done - t_submit)
+            obs_quantiles.observe("serve_request_seconds",
+                                  res.t_done - t_submit)
         return Future.completed(res)
 
     def _finish(self, req: ServeRequest, res: ServeResult) -> None:
         res.t_done = time.monotonic()
-        H_E2E.observe(res.t_done - req.t_submit)
-        obs_trace.add_span("serve.request", res.t_done - req.t_submit,
-                           wid=req.wid, status=res.status)
+        e2e = res.t_done - req.t_submit
+        H_E2E.observe(e2e)
+        # live sliding-window quantiles with an exemplar: the window's
+        # worst request keeps the trace id its batch dispatched under,
+        # so a bad p99 on the scrape links straight to its Perfetto
+        # timeline
+        obs_quantiles.observe("serve_request_seconds", e2e,
+                              trace_id=req.trace_id)
+        obs_trace.add_span("serve.request", e2e, wid=req.wid,
+                           status=res.status,
+                           **({"trace_id": req.trace_id}
+                              if req.trace_id else {}))
         req.future.set(res)
 
     def _dispatch_batch(self, wid: int, batch: list[ServeRequest]) -> None:
@@ -248,6 +298,25 @@ class ServingFrontend:
                 live.append(r)
         if not live:
             return
+        # with tracing on, every batch gets its own trace id: it rides
+        # the wire (RuntimeConfig extension) so the worker ships its
+        # spans back under it, it stamps each request (the quantile
+        # exemplar key), and it tags this thread's log records — scoped
+        # to this batch (the runner thread persists; a leaked id would
+        # mislabel between-batch log records with the PREVIOUS batch)
+        if obs_trace.enabled():
+            tid = obs_trace.new_trace_id()
+            obs_trace.set_trace_id(tid)
+            for r in live:
+                r.trace_id = tid
+            try:
+                self._dispatch_live(wid, live)
+            finally:
+                obs_trace.set_trace_id(None)
+        else:
+            self._dispatch_live(wid, live)
+
+    def _dispatch_live(self, wid: int, live: list[ServeRequest]) -> None:
         queries = np.asarray([[r.s, r.t] for r in live], np.int64)
         # pin the diff actually dispatched: a set_diff racing this batch
         # must not let answers computed under the NEW diff be cached
@@ -275,7 +344,8 @@ class ServingFrontend:
             attempted = True
             try:
                 cost, plen, fin = self._dispatch_hedged(
-                    wid, via, candidates, queries, diff)
+                    wid, via, candidates, queries, diff,
+                    tid=live[0].trace_id)
                 ok = True
             except Exception as e:  # noqa: BLE001 — any dispatch
                 # failure becomes a breaker failure record (booked by
@@ -310,11 +380,21 @@ class ServingFrontend:
                                         plen=val[1], finished=val[2]))
 
     # ------------------------------------------------- hedged dispatch
-    def _answer_once(self, wid: int, via: int, queries, diff: str):
+    def _answer_once(self, wid: int, via: int, queries, diff: str,
+                     tid: str = ""):
+        """One dispatch lane. ``tid`` is the batch's trace id: it tags
+        this thread (hedge lanes run on fresh threads that would
+        otherwise be untagged), rides the wire so a FIFO worker captures
+        its spans under it, and labels the dispatch span."""
+        rconf = self.rconf
+        if tid:
+            obs_trace.set_trace_id(tid)
+            if not rconf.trace_id:
+                rconf = dataclasses.replace(rconf, trace_id=tid)
         with obs_trace.span("serve.dispatch", wid=via, shard=wid,
                             size=len(queries)):
             return self.dispatcher.answer_batch(
-                wid, queries, self.rconf, diff, via=via)
+                wid, queries, rconf, diff, via=via)
 
     def _hedge_target(self, wid: int, via: int, candidates) -> int | None:
         """The replica a hedge would duplicate to: the first candidate
@@ -333,7 +413,7 @@ class ServingFrontend:
             self.registry.record(self._breaker_key(target), ok)
 
     def _dispatch_hedged(self, wid: int, via: int, candidates,
-                         queries, diff: str):
+                         queries, diff: str, tid: str = ""):
         """One batch through ``via``, hedged: if no answer lands within
         the shard's adaptive delay (recent latency quantile, floor
         ``DOS_HEDGE_MIN_MS``) and the hedge budget grants, a duplicate
@@ -362,19 +442,23 @@ class ServingFrontend:
             # hedge anyway)
             t0 = time.monotonic()
             try:
-                out = self._answer_once(wid, via, queries, diff)
+                out = self._answer_once(wid, via, queries, diff, tid=tid)
             except Exception:
                 self._record(via, False)
                 raise
             self._record(via, True)
-            self.hedge.observe(wid, time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self.hedge.observe(wid, dt)
+            obs_quantiles.observe("serve_dispatch_seconds", dt,
+                                  trace_id=tid)
             return out
         results: _stdqueue.Queue = _stdqueue.Queue()
 
         def run(target: int, is_hedge: bool) -> None:
             t0 = time.monotonic()
             try:
-                r = self._answer_once(wid, target, queries, diff)
+                r = self._answer_once(wid, target, queries, diff,
+                                      tid=tid)
             except Exception as e:  # noqa: BLE001 — collected below
                 self._record(target, False)
                 results.put((is_hedge, None, e, time.monotonic() - t0))
@@ -416,4 +500,6 @@ class ServingFrontend:
             # must not inflate the hedge-effectiveness headline
             M_WON.inc()
         self.hedge.observe(wid, duration)
+        obs_quantiles.observe("serve_dispatch_seconds", duration,
+                              trace_id=tid)
         return out

@@ -112,6 +112,10 @@ class ServeRequest:
     deadline: float | None = None
     future: Future = dataclasses.field(default_factory=Future)
     t_enqueue: float = 0.0
+    #: the batch trace id this request dispatched under (stamped by the
+    #: frontend when tracing is on) — the exemplar key that links a bad
+    #: latency observation to its Perfetto timeline
+    trace_id: str = ""
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline

@@ -245,6 +245,21 @@ class BreakerRegistry:
                                   b.consecutive_failures}
                     for k, b in self._breakers.items()}
 
+    def statusz(self) -> dict:
+        """The ``/statusz`` section (``obs.http``): breaker states plus
+        the registry's knobs and the open count — "which breaker is
+        open" answered by a live scrape instead of a post-mortem
+        ``degraded.json``."""
+        breakers = self.snapshot()
+        return {
+            "enabled": self.enabled,
+            "threshold": self.threshold,
+            "cooldown_s": self.cooldown_s,
+            "open": sum(1 for b in breakers.values()
+                        if b["state"] != CLOSED),
+            "breakers": breakers,
+        }
+
 
 def send_failover(candidates, send_fn, registry=None):
     """Walk a shard's replica chain until one worker answers.

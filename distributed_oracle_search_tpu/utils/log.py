@@ -12,6 +12,12 @@ the **current thread** (each ``FifoServer.serve_forever`` loop is one
 thread, and the engine logs from the same thread), and the handler's
 filter stamps ``[w<id>]`` into the format — ``-`` for head-side /
 untagged threads.
+
+Records additionally carry the thread's current **trace id**
+(``obs.trace.current_trace_id`` — set while a traced batch is in
+flight): the ``[w3]`` tag becomes ``[w3 t:5f1c...]``, so grepping a
+degraded batch's logs and opening its span timeline in Perfetto use
+the same key. Untraced records keep the bare ``[w3]`` form.
 """
 
 from __future__ import annotations
@@ -35,11 +41,20 @@ def get_worker_id() -> int | str | None:
 
 
 class _WorkerIdFilter(logging.Filter):
-    """Stamp the thread's worker id onto every record (``-`` if unset)."""
+    """Stamp the thread's worker id (``-`` if unset) and, when a traced
+    batch is in flight, its trace id onto every record."""
 
     def filter(self, record: logging.LogRecord) -> bool:
         wid = getattr(_ctx, "wid", None)
         record.worker = "-" if wid is None else wid
+        # lazy import: obs.trace is further up the import graph and the
+        # filter must work even if the obs package is mid-import
+        try:
+            from ..obs.trace import current_trace_id
+            tid = current_trace_id()
+        except ImportError:
+            tid = None
+        record.trace = f" t:{tid}" if tid else ""
         return True
 
 
@@ -51,7 +66,7 @@ def _ensure_handler(root: logging.Logger) -> None:
     if not root.handlers:
         handler = logging.StreamHandler()
         handler.setFormatter(logging.Formatter(
-            "%(asctime)s %(name)s [w%(worker)s] %(levelname)s: "
+            "%(asctime)s %(name)s [w%(worker)s%(trace)s] %(levelname)s: "
             "%(message)s"))
         handler.addFilter(_WorkerIdFilter())
         root.addHandler(handler)

@@ -426,20 +426,11 @@ class FifoServer:
     def _answer_ping(self, line: str) -> None:
         """Answer a ``__DOS_PING__ <answerfifo>`` control frame with one
         health JSON line (:class:`~..transport.wire.HealthStatus`)."""
-        import time as _time
-
         toks = line.split()
         if len(toks) < 2:
             log.error("ping frame names no answer FIFO: %r", line)
             return
-        status = HealthStatus(
-            ok=True, wid=self.wid, pid=os.getpid(),
-            uptime_s=_time.monotonic() - getattr(self, "_t_start", 0.0),
-            batches=getattr(self, "_batches", 0),
-            batch_failures=getattr(self, "_batch_failures", 0),
-            dropped=int(M_DROPPED.value),
-            last_error=getattr(self, "_last_error", ""),
-        )
+        status = self._health_status()
         self._reply(toks[1], status.to_json() + "\n",
                     deadline_s=self.PING_REPLY_DEADLINE_S,
                     drop_counter=M_PING_DROPS)
@@ -448,6 +439,52 @@ class FifoServer:
     def stop_file(self) -> None:
         """Write the stop token into our own FIFO (for another process)."""
         stop_server(self.command_fifo)
+
+    # ----------------------------------------------------- obs endpoints
+    def _health_status(self) -> HealthStatus:
+        """One health truth for both probes: the ``__DOS_PING__``
+        control frame and the ``/healthz`` endpoint serialize this
+        same object."""
+        import time as _time
+
+        return HealthStatus(
+            ok=True, wid=self.wid, pid=os.getpid(),
+            uptime_s=_time.monotonic() - getattr(self, "_t_start", 0.0),
+            batches=getattr(self, "_batches", 0),
+            batch_failures=getattr(self, "_batch_failures", 0),
+            dropped=int(M_DROPPED.value),
+            last_error=getattr(self, "_last_error", ""),
+        )
+
+    def health(self) -> dict:
+        """``/healthz`` payload — the same :class:`HealthStatus`
+        a ``__DOS_PING__`` probe gets, minus the FIFO."""
+        import dataclasses as _dc
+
+        return _dc.asdict(self._health_status())
+
+    def statusz(self) -> dict:
+        """``/statusz`` section: serve-loop health plus what this worker
+        actually hosts — its shard, any lazily-loaded replica engines
+        (is failover traffic landing here?), and the build ledger's
+        journaled-block count (how far a crash-resumed build got)."""
+        from ..models.cpd import BuildLedger
+
+        out = dict(self.health())
+        out["alg"] = self.alg
+        out["command_fifo"] = self.command_fifo
+        out["shard"] = self.wid
+        out["replica_shards_loaded"] = sorted(
+            s for s in self._replica_engines if s != self.wid)
+        if self.dc.replication > 1:
+            out["replica_shards_hosted"] = sorted(
+                int(s) for s in self.dc.replica_shards(self.wid))
+        try:
+            out["build_ledger_blocks"] = len(
+                BuildLedger(self.conf.outdir, self.wid).entries())
+        except (OSError, ValueError):
+            out["build_ledger_blocks"] = 0
+        return out
 
 
 def stop_server(command_fifo: str, deadline_s: float = 2.0) -> bool:
@@ -509,6 +546,10 @@ def main(argv=None) -> int:
     p.add_argument("--metrics-dump", default="",
                    help="write a JSON metrics snapshot (obs.metrics) to "
                         "this path on clean shutdown")
+    p.add_argument("--obs-port", type=int, default=None,
+                   help="serve live /metrics /healthz /statusz on this "
+                        "port (0 = ephemeral; default off; "
+                        "DOS_OBS_PORT)")
     args = p.parse_args(argv)
     set_verbosity(args.verbose)
     set_worker_id(args.workerid)
@@ -516,9 +557,15 @@ def main(argv=None) -> int:
     conf = ClusterConfig.load(args.c)
     server = FifoServer(conf, args.workerid, command_fifo=args.fifo,
                         alg=args.alg)
+    from ..obs.http import start_obs_server
+    obs_srv = start_obs_server(
+        args.obs_port, health_fn=server.health,
+        status_providers={"worker": server.statusz})
     try:
         server.serve_forever()
     finally:
+        if obs_srv is not None:
+            obs_srv.close()
         if args.metrics_dump:
             obs_metrics.REGISTRY.dump_json(args.metrics_dump)
     return 0

@@ -138,9 +138,15 @@ class WorkerSupervisor:
             os.makedirs(self.logdir, exist_ok=True)
             out = open(os.path.join(self.logdir, f"worker{w.wid}.log"),
                        "ab")
+        # DOS_OBS_PORT names ONE port: the supervisor's own obs server
+        # binds it; letting N children inherit it would put every
+        # worker in contention for the same socket (give workers their
+        # own ports via per-worker --obs-port wiring when needed)
+        env = {k: v for k, v in os.environ.items()
+               if k != "DOS_OBS_PORT"}
         return subprocess.Popen(cmd, cwd=self.conf.projectdir,
                                 stdout=out, stderr=subprocess.STDOUT,
-                                start_new_session=True)
+                                start_new_session=True, env=env)
 
     def _probe_server(self, w: SupervisedWorker):
         return fifo_transport.probe(
@@ -225,6 +231,38 @@ class WorkerSupervisor:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # ---------------------------------------------------- obs endpoints
+    def health(self) -> dict:
+        """``/healthz``: ok iff every supervised worker process is
+        currently running (a worker mid-backoff reports unhealthy —
+        exactly when an orchestrator should hold traffic)."""
+        running = sum(
+            1 for w in self.workers.values()
+            if w.proc is not None and w.proc.poll() is None)
+        return {"ok": running == len(self.workers),
+                "alive": running, "workers": len(self.workers)}
+
+    def statusz(self) -> dict:
+        """``/statusz`` section: per-worker process/respawn/ping state."""
+        workers = {}
+        for w in self.workers.values():
+            workers[str(w.wid)] = {
+                "pid": w.proc.pid if w.proc is not None else None,
+                "running": (w.proc is not None
+                            and w.proc.poll() is None),
+                "respawns": w.respawns,
+                "backoff_step": w.backoff_k,
+                "ping_failures": w.ping_failures,
+                "healthy_once": w.healthy_once,
+                "fifo": w.fifo,
+            }
+        h = self.health()
+        return {"alive": h["alive"], "workers_total": h["workers"],
+                "respawns": sum(w.respawns
+                                for w in self.workers.values()),
+                "ping_interval_s": self.ping_interval_s,
+                "workers": workers}
+
     # --------------------------------------------------------- monitor
     def _backoff_s(self, w: SupervisedWorker) -> float:
         return min(self.backoff_cap_s,
@@ -301,16 +339,31 @@ class WorkerSupervisor:
 
 def supervise_forever(conf: ClusterConfig, conf_path: str,
                       alg: str = "table-search",
-                      logdir: str | None = None) -> int:
-    """``make_fifos --supervise`` entry: run until interrupted."""
+                      logdir: str | None = None,
+                      obs_port: int | None = None) -> int:
+    """``make_fifos --supervise`` entry: run until interrupted.
+    ``obs_port`` (or ``DOS_OBS_PORT``) additionally serves live
+    ``/metrics`` ``/healthz`` ``/statusz`` for the whole supervised
+    fleet — healthz goes 503 the moment any worker is down."""
+    from ..obs.http import start_obs_server
+
     sup = WorkerSupervisor(conf, conf_path, alg=alg, logdir=logdir)
-    sup.start()
-    print(f"supervising {len(sup.workers)} worker(s); Ctrl-C to stop")
+    obs_srv = None
     try:
+        sup.start()
+        # inside the try: a bind failure (port taken) must tear the
+        # just-spawned workers down, not orphan them unsupervised
+        obs_srv = start_obs_server(
+            obs_port, health_fn=sup.health,
+            status_providers={"supervisor": sup.statusz})
+        print(f"supervising {len(sup.workers)} worker(s); "
+              "Ctrl-C to stop")
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         log.info("supervisor: interrupted; stopping workers")
     finally:
+        if obs_srv is not None:
+            obs_srv.close()
         sup.stop()
     return 0
