@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import csv
 import dataclasses
-import json
 import os
 import sys
 
@@ -47,9 +46,9 @@ from ..transport.wire import (
 from ..parallel.multihost import is_primary
 from ..transport import fifo as fifo_transport
 from ..transport import resilience
-from ..utils.atomicio import sweep_stale_artifacts
+from ..utils.atomicio import atomic_write_json, atomic_writer, sweep_stale_artifacts
 from ..utils.config import ClusterConfig, test_config
-from ..utils.env import env_cast
+from ..utils.env import env_cast, env_flag
 from ..utils.log import get_logger, set_verbosity
 from ..utils.timer import Timer
 
@@ -154,11 +153,7 @@ class _StreamedServe:
                 write_index_manifest(outdir, dc)
             barrier("dos-streamed-manifest")
         self.dc = dc
-        try:
-            row_chunk = int(os.environ.get("DOS_STREAM_ROW_CHUNK",
-                                           "4096"))
-        except ValueError:
-            row_chunk = 4096
+        row_chunk = env_cast("DOS_STREAM_ROW_CHUNK", 4096, int)
         self.st = StreamedCPDOracle(graph, dc, outdir,
                                     row_chunk=row_chunk)
 
@@ -304,7 +299,7 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
         # DOS_ASTAR_DEVICE=1 opts into the device kernel explicitly.
         from ..ops.batched_astar import astar_batch_np
 
-        astar_device = os.environ.get("DOS_ASTAR_DEVICE", "") == "1"
+        astar_device = env_flag("DOS_ASTAR_DEVICE", False)
         log.info(
             "--alg astar served by the %s", "batched DEVICE kernel "
             "(DOS_ASTAR_DEVICE=1)" if astar_device else
@@ -318,12 +313,9 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
         # regime where one chip's N^2/W outgrows HBM — README "Serving
         # modes"). DOS_SERVE_STREAMED=1 forces; DOS_FM_BUDGET_GB
         # (default 8) is the per-device residency budget.
-        try:
-            fm_gb = float(os.environ.get("DOS_FM_BUDGET_GB", "8"))
-        except ValueError:
-            fm_gb = 8.0
+        fm_gb = env_cast("DOS_FM_BUDGET_GB", 8.0, float)
         est_shard = dc.max_owned * graph.n            # int8 fm bytes
-        forced = os.environ.get("DOS_SERVE_STREAMED", "") == "1"
+        forced = env_flag("DOS_SERVE_STREAMED", False)
         if forced or est_shard > fm_gb * 1e9:
             log.info(
                 "serving streamed%s: per-device fm shard %.2f GB vs "
@@ -754,8 +746,7 @@ def write_degraded_manifest(dirname: str, data, stats) -> str:
         "failed_batches": failures,
     }
     path = os.path.join(dirname, "degraded.json")
-    with open(path, "w") as f:
-        json.dump(manifest, f, indent=1)
+    atomic_write_json(path, manifest)
     return path
 
 
@@ -780,11 +771,9 @@ def output(data, stats, args, paths=None) -> None:
         return
     dirname = args.output
     os.makedirs(dirname, exist_ok=True)
-    with open(os.path.join(dirname, "metrics.json"), "w") as f:
-        json.dump(data, f)
-    with open(os.path.join(dirname, "data.json"), "w") as f:
-        json.dump(vars(args), f)
-    with open(os.path.join(dirname, "parts.csv"), "w") as f:
+    atomic_write_json(os.path.join(dirname, "metrics.json"), data)
+    atomic_write_json(os.path.join(dirname, "data.json"), vars(args))
+    with atomic_writer(os.path.join(dirname, "parts.csv")) as f:
         writer = csv.writer(f, quoting=csv.QUOTE_MINIMAL)
         writer.writerow(STATS_HEADER)
         writer.writerows([i, *row] for i, expe in enumerate(stats)
@@ -799,7 +788,7 @@ def output(data, stats, args, paths=None) -> None:
         log.error("degraded campaign: manifest written to %s", path)
     if paths is not None:
         k = paths.shape[1] - 4
-        with open(os.path.join(dirname, "paths.csv"), "w") as f:
+        with atomic_writer(os.path.join(dirname, "paths.csv")) as f:
             writer = csv.writer(f, quoting=csv.QUOTE_MINIMAL)
             writer.writerow(["s", "t", "moves"]
                             + [f"n{j}" for j in range(k + 1)])

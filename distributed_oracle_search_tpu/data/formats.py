@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils.atomicio import atomic_write_bytes
+
 XY_MAGIC = "xy graph"
 INT_WEIGHT_DTYPE = np.int32
 
@@ -45,13 +47,10 @@ INT_WEIGHT_DTYPE = np.int32
 def write_xy(path: str, xs: np.ndarray, ys: np.ndarray,
              src: np.ndarray, dst: np.ndarray, w: np.ndarray) -> None:
     n, m = len(xs), len(src)
-    with open(path, "w") as f:
-        f.write(f"{XY_MAGIC}\nv 1\nheader end\n")
-        f.write(f"p {n} {m} 0\n")
-        out = ["v %d %d" % (x, y) for x, y in zip(xs, ys)]
-        out += ["e %d %d %d" % (u, v, ww) for u, v, ww in zip(src, dst, w)]
-        f.write("\n".join(out))
-        f.write("\n")
+    out = [f"{XY_MAGIC}\nv 1\nheader end\np {n} {m} 0"]
+    out += ["v %d %d" % (x, y) for x, y in zip(xs, ys)]
+    out += ["e %d %d %d" % (u, v, ww) for u, v, ww in zip(src, dst, w)]
+    atomic_write_bytes(path, ("\n".join(out) + "\n").encode())
 
 
 def xy_node_count(path: str) -> int:
@@ -97,12 +96,11 @@ def read_xy(path: str):
 
 
 def write_scen(path: str, queries: np.ndarray, comment: str = "") -> None:
-    with open(path, "w") as f:
-        f.write("c tpu-oracle scenario v1\n")
-        if comment:
-            f.write(f"c {comment}\n")
-        f.write("\n".join("q %d %d" % (s, t) for s, t in queries))
-        f.write("\n")
+    out = ["c tpu-oracle scenario v1"]
+    if comment:
+        out.append(f"c {comment}")
+    out += ["q %d %d" % (s, t) for s, t in queries]
+    atomic_write_bytes(path, ("\n".join(out) + "\n").encode())
 
 
 def read_scen(path: str) -> np.ndarray:
@@ -126,11 +124,9 @@ def read_scen(path: str) -> np.ndarray:
 
 def write_diff(path: str, src: np.ndarray, dst: np.ndarray,
                new_w: np.ndarray) -> None:
-    with open(path, "w") as f:
-        f.write(f"d {len(src)}\n")
-        f.write("\n".join("%d %d %d" % (u, v, ww)
-                          for u, v, ww in zip(src, dst, new_w)))
-        f.write("\n")
+    out = [f"d {len(src)}"]
+    out += ["%d %d %d" % (u, v, ww) for u, v, ww in zip(src, dst, new_w)]
+    atomic_write_bytes(path, ("\n".join(out) + "\n").encode())
 
 
 def read_diff(path: str):

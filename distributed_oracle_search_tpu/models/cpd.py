@@ -54,6 +54,7 @@ from ..utils.atomicio import (
     SWEEP_MIN_AGE_S, TMP_SUFFIX, atomic_save_npy, atomic_write_json,
     digest_bytes, digest_file, quarantine,
 )
+from ..utils.env import env_cast, env_flag
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -127,7 +128,7 @@ def _fm_rle_encode(fm: jnp.ndarray, cap: int):
 
 def _fetch_rle_eligible(shape) -> bool:
     c, n = shape
-    return (os.environ.get("DOS_FETCH_RLE", "1") != "0" and c >= 2
+    return (env_flag("DOS_FETCH_RLE", True) and c >= 2
             and c <= 65535 and c * n >= FETCH_RLE_MIN_BYTES)
 
 
@@ -267,7 +268,11 @@ def block_complete(outdir: str, fname: str,
     try:
         np.load(path, mmap_mode="r")
         return True
-    except Exception:  # noqa: BLE001 — any unreadable file means rebuild
+    except Exception as e:  # noqa: BLE001 — any unreadable file means
+        # rebuild; say which file and why, or the operator sees an
+        # unexplained non-skip on every resume
+        log.debug("unledgered block %s unreadable (%s); rebuilding",
+                  fname, e)
         return False
 
 
@@ -1282,10 +1287,7 @@ class CPDOracle:
         DOS_TABLE_BUDGET_GB works as a runtime knob; malformed values
         fall back to the default (8 GB — conservative v5e headroom next
         to the resident fm + dists) instead of crashing."""
-        try:
-            gb = float(os.environ.get("DOS_TABLE_BUDGET_GB", "8"))
-        except ValueError:
-            gb = 8.0
+        gb = env_cast("DOS_TABLE_BUDGET_GB", 8.0, float)
         return int((gb if gb > 0 else 8.0) * 1e9)
 
     def prepare_weights(self, w_query: np.ndarray | None = None,

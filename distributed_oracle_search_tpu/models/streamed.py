@@ -57,6 +57,10 @@ from ..ops.table_search import (
 )
 from ..parallel.partition import DistributionController
 from .cpd import length_estimate, shard_block_name, validate_manifest
+from ..utils.env import env_cast, env_flag
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
 
 
 def _pow2(x: int) -> int:
@@ -255,8 +259,10 @@ def default_cache_bytes() -> int:
         limit = int(stats.get("bytes_limit", 0))
         if limit > 0:
             return limit // 4
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — backends without
+        # memory_stats fall back to the conservative default
+        log.debug("memory_stats unavailable (%s); stream cache "
+                  "defaults to 1 GiB", e)
     return 1 << 30
 
 
@@ -302,17 +308,17 @@ class StreamedCPDOracle:
         #: chunk, so warm rounds are unchanged). High slots ride a tiny
         #: exception list, so this is degree-independent; a chunk whose
         #: escape fraction is degenerate falls back to raw per-chunk.
-        self.pack4 = os.environ.get("DOS_STREAM_PACK4", "1") != "0"
+        self.pack4 = env_flag("DOS_STREAM_PACK4", True)
         #: transposed target-axis RLE — the cold path's big lever
         #: (~7-17x fewer wire bytes measured on road/city chunks vs the
         #: raw fm, vs pack4's fixed 2x); falls back per-chunk via
         #: :func:`_pack_rle`'s break-even check
-        self.rle = os.environ.get("DOS_STREAM_RLE", "1") != "0"
+        self.rle = env_flag("DOS_STREAM_RLE", True)
         #: persist encodings as npz sidecars in the index dir (see the
         #: module-level RLE notes); the first cold round pays the encode,
         #: every later one streams straight off the compressed sidecar
-        self.rle_sidecar = (self.rle and os.environ.get(
-            "DOS_STREAM_RLE_SIDECAR", "1") != "0")
+        self.rle_sidecar = (self.rle
+                            and env_flag("DOS_STREAM_RLE_SIDECAR", True))
         #: telemetry of the most recent :meth:`query` call
         self.last_stats: dict = {}
 
@@ -360,8 +366,10 @@ class StreamedCPDOracle:
                     if "fallback" in z:
                         return "fallback"
                     return z["lens"], z["vals"], z["counts"]
-        except Exception:          # corrupt zip, missing keys, IO — any
-            pass                   # failure means "re-encode", never raise
+        except Exception as e:  # noqa: BLE001 — corrupt zip, missing
+            # keys, IO: any failure means "re-encode", never raise
+            log.debug("RLE sidecar %s unusable (%s); re-encoding",
+                      path, e)
         return None
 
     def _sidecar_save(self, path: str, fp: np.ndarray, enc) -> None:
@@ -503,11 +511,7 @@ class StreamedCPDOracle:
         # copy_bw / (copy_bw + uplink_bw) — ~0.45 with the measured
         # 185 MB/s host row-copy vs 257 MB/s uplink here; a fast PCIe
         # link pushes it even lower. DOS_STREAM_RANGE_DENSITY overrides.
-        try:
-            thresh = float(os.environ.get("DOS_STREAM_RANGE_DENSITY",
-                                          "0.45"))
-        except ValueError:
-            thresh = 0.45
+        thresh = env_cast("DOS_STREAM_RANGE_DENSITY", 0.45, float)
         n_range = max(-(-max(self.dc.max_owned, 1) // c), 1)
         rkey = u_wid.astype(np.int64) * n_range + u_row // c
         uniq_key = np.unique(rkey)

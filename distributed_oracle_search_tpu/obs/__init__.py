@@ -60,10 +60,20 @@ stats field    obs metrics covering the same interval
                (FIFO round-trip, includes the worker's t_search)
 =============  =====================================================
 
+Campaign-path volume/phase series (head and worker sides of the same
+batches): ``head_batches_total`` / ``head_batches_failed_total`` and
+``head_partition_seconds`` / ``head_prepare_seconds`` /
+``head_send_seconds`` / ``head_search_seconds`` on the head;
+``worker_batches_total`` / ``worker_queries_total`` and
+``server_replies_sent_total`` on the worker (sent replies are the
+complement of the drop counters below).
+
 Server failure paths (no stats-field analog — the reference dropped
 these on the floor): ``server_frames_received_total``,
 ``server_frames_malformed_total``, ``server_frames_half_total``,
-``server_replies_dropped_total``, ``server_batches_failed_total``, and
+``server_replies_dropped_total``, ``server_ping_replies_dropped_total``
+(control-frame drops split out so they never pollute the data-plane
+drop alert), ``server_batches_failed_total``, and
 ``server_reply_open_wait_seconds`` (how long replies waited for the
 head's answer-FIFO reader).
 

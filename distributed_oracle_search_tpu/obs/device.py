@@ -34,6 +34,7 @@ from __future__ import annotations
 import os
 import threading
 
+from ..utils.env import env_flag
 from ..utils.log import get_logger
 from . import metrics as obs_metrics
 
@@ -52,7 +53,7 @@ _MEM_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
 
 
 def enabled() -> bool:
-    return os.environ.get("DOS_DEVICE_COSTS", "1") != "0"
+    return env_flag("DOS_DEVICE_COSTS", True)
 
 
 def analyze(fn, *args, **kwargs) -> dict | None:

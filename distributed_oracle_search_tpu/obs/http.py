@@ -40,7 +40,7 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..utils.env import env_cast
+from ..utils.env import env_cast, env_str
 from ..utils.log import get_logger
 from . import device as obs_device
 from . import metrics as obs_metrics
@@ -85,7 +85,7 @@ class ObsServer:
             # and /statusz names FIFO paths and topology — widening to
             # a routable interface is an explicit operator decision
             # (DOS_OBS_HOST=0.0.0.0 for a scraped fleet)
-            host = os.environ.get("DOS_OBS_HOST", "127.0.0.1")
+            host = env_str("DOS_OBS_HOST", "127.0.0.1")
         self._httpd = ThreadingHTTPServer((host, int(port)),
                                           self._make_handler())
         self._httpd.daemon_threads = True

@@ -25,7 +25,8 @@ from __future__ import annotations
 
 import json
 import re
-import threading
+
+from ..utils.locks import OrderedLock
 
 #: default latency buckets (seconds) — tuned for the serve path, where a
 #: batch spans ~100us (warm gather) to minutes (cold XLA compile)
@@ -41,7 +42,7 @@ class Counter:
         self.name = name
         self.help = help
         self._value = 0
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics.Counter")
 
     def inc(self, n: int | float = 1) -> None:
         with self._lock:
@@ -61,7 +62,7 @@ class Gauge:
         self.name = name
         self.help = help
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics.Gauge")
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -91,7 +92,7 @@ class Histogram:
         self._counts = [0] * len(self.buckets)
         self._sum = 0.0
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics.Histogram")
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -132,7 +133,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics.MetricsRegistry")
 
     def _get_or_create(self, name: str, kind, **kwargs):
         with self._lock:

@@ -40,10 +40,10 @@ from __future__ import annotations
 
 import math
 import random
-import threading
 import time
 
 from ..utils.env import env_cast
+from ..utils.locks import OrderedLock
 
 #: the quantiles every window reports (scrape + statusz)
 DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
@@ -88,7 +88,7 @@ class SlidingQuantiles:
         self.clock = clock
         self._ring = [_Bucket() for _ in range(self.n_buckets)]
         self._rng = random.Random(0x0b5)
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("quantiles.SlidingQuantiles")
 
     # ------------------------------------------------------------ write
     def observe(self, v: float, trace_id: str | None = None,
@@ -186,7 +186,7 @@ class QuantileWindows:
         self.max_samples = max_samples
         self.clock = clock
         self._windows: dict[str, SlidingQuantiles] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("quantiles.QuantileWindows")
 
     def window(self, name: str) -> SlidingQuantiles:
         with self._lock:

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os
 
+from ..utils.env import env_str
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -107,7 +108,7 @@ def initialize_from_conf(conf) -> bool:
         mh = conf.get("multihost")
     if not mh:
         return False
-    pid = mh.get("process_id", os.environ.get("DOS_PROCESS_ID"))
+    pid = mh.get("process_id", env_str("DOS_PROCESS_ID"))
     cpus = mh.get("cpu_devices_per_process")  # CPU-backed pods / tests
     initialize(coordinator=mh.get("coordinator"),
                num_processes=mh.get("num_processes"),

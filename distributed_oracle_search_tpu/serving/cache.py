@@ -16,8 +16,9 @@ batcher threads fill on the completion path.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
+
+from ..utils.locks import OrderedLock
 
 from ..obs import metrics as obs_metrics
 
@@ -56,7 +57,7 @@ class ResultCache:
         self.max_bytes = int(max_bytes)
         self.max_entries = self.max_bytes // ENTRY_BYTES
         self._od: OrderedDict[tuple, tuple] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serving.ResultCache")
 
     @property
     def enabled(self) -> bool:

@@ -28,7 +28,6 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import os
-import threading
 
 import numpy as np
 
@@ -40,6 +39,7 @@ from ..transport.wire import (
     write_query_file,
 )
 from ..utils.config import ClusterConfig
+from ..utils.locks import OrderedLock
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -77,7 +77,7 @@ class EngineDispatcher:
         self.build_missing = build_missing
         self.build_chunk = build_chunk
         self._engines: dict[tuple, object] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serving.EngineDispatcher")
 
     def _build_missing_shard(self, shard: int, replica: int) -> None:
         from ..models.cpd import (
@@ -163,14 +163,15 @@ class FifoDispatcher:
         #: in-flight query file / answer FIFOs. The worker's command
         #: FIFO serializes same-worker batches anyway, so the lock adds
         #: ordering, not latency.
-        self._lane_locks: dict[tuple, threading.Lock] = {}
-        self._locks_guard = threading.Lock()
+        self._lane_locks: dict[tuple, OrderedLock] = {}
+        self._locks_guard = OrderedLock("serving.FifoDispatcher.guard")
 
-    def _lane_lock(self, lane: tuple) -> threading.Lock:
+    def _lane_lock(self, lane: tuple) -> OrderedLock:
         with self._locks_guard:
             lock = self._lane_locks.get(lane)
             if lock is None:
-                lock = self._lane_locks[lane] = threading.Lock()
+                lock = self._lane_locks[lane] = OrderedLock(
+                    "serving.FifoDispatcher.lane")
             return lock
 
     def _sweep_prev(self, lane: tuple) -> None:
@@ -232,6 +233,12 @@ class FifoDispatcher:
             req = Request(
                 dataclasses.replace(rconf, results=True), qfile,
                 answer_base, diff)
+            # dos-lint: disable=lock-scope -- holding the lane lock
+            #   across the wire send is the invariant, not an accident:
+            #   the lock exists to serialize same-lane batches so the
+            #   next batch's _sweep_prev can't unlink THIS batch's
+            #   in-flight files; the worker's command FIFO serializes
+            #   same-worker sends anyway, so it adds ordering, not wait
             row = fifo_transport.send_with_retry(
                 host, req, command_fifo_path(via), timeout=self.timeout,
                 policy=self.policy, wid=via)

@@ -35,11 +35,11 @@ never wrong.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from collections import deque
 
 from ..obs import metrics as obs_metrics
 from ..utils.env import env_cast
+from ..utils.locks import OrderedLock
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -121,7 +121,7 @@ class HedgeTracker:
         self._lat: dict[int, deque] = {}
         self._dispatches = 0
         self._hedges = 0
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serving.HedgeTracker")
 
     # ------------------------------------------------------------ stats
     def observe(self, wid: int, seconds: float) -> None:

@@ -18,6 +18,7 @@ import time
 from collections import deque
 
 from ..obs import metrics as obs_metrics
+from ..utils.locks import ordered_condition
 from .request import ServeRequest
 
 G_DEPTH = obs_metrics.gauge(
@@ -38,7 +39,7 @@ class ShardQueue:
         #: the aggregate ``serve_queue_depth`` always updates)
         self._gauge = gauge
         self._q: deque[ServeRequest] = deque()
-        self._cond = threading.Condition()
+        self._cond = ordered_condition("serving.ShardQueue")
         self._closed = False
 
     def _book(self, delta: int) -> None:

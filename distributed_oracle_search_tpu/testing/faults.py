@@ -43,10 +43,10 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import os
 import threading
 
 from ..obs import metrics as obs_metrics
+from ..utils.env import env_str
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -188,10 +188,10 @@ def active() -> FaultInjector | None:
     in-process counts survive across calls, and an env change — tests
     monkeypatching ``DOS_FAULTS`` — rebuilds)."""
     global _cache
-    spec = os.environ.get("DOS_FAULTS", "")
+    spec = env_str("DOS_FAULTS", "")
     if not spec:
         return None
-    key = (spec, os.environ.get("DOS_FAULTS_STATE") or None)
+    key = (spec, env_str("DOS_FAULTS_STATE") or None)
     with _cache_lock:
         if _cache is None or _cache[0] != key:
             _cache = (key, FaultInjector(parse_faults(spec),
@@ -202,7 +202,7 @@ def active() -> FaultInjector | None:
 def inject(point: str, wid: int | None = None) -> FaultRule | None:
     """The production hook: returns the fired rule, or None. Zero-cost
     (one dict lookup) when ``DOS_FAULTS`` is unset."""
-    if "DOS_FAULTS" not in os.environ:
+    if not env_str("DOS_FAULTS"):
         return None
     inj = active()
     return inj.fire(point, wid=wid) if inj is not None else None

@@ -26,12 +26,12 @@ no campaign leaves one behind.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 
 from ..obs import metrics as obs_metrics
-from ..utils.env import env_cast
+from ..utils.env import env_cast, env_flag
+from ..utils.locks import OrderedLock
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -70,7 +70,7 @@ class CircuitBreaker:
         self.consecutive_failures = 0
         self.opened_at = 0.0
         self._trial_in_flight = False
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("resilience.CircuitBreaker")
 
     def allow(self) -> bool:
         """May the caller send a batch to this worker right now?"""
@@ -164,14 +164,13 @@ class BreakerRegistry:
                            else env_cast("DOS_CIRCUIT_COOLDOWN_S", 5.0,
                                          float))
         self.enabled = (enabled if enabled is not None
-                        else os.environ.get("DOS_CIRCUIT_DISABLE", "")
-                        != "1")
+                        else not env_flag("DOS_CIRCUIT_DISABLE", False))
         self.probe_fn = probe_fn
         self.clock = clock
         self._breakers: dict = {}
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("resilience.BreakerRegistry")
 
     def get(self, key) -> CircuitBreaker:
         with self._lock:

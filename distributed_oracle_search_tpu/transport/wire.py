@@ -23,9 +23,12 @@ helpers — so both the Python/JAX worker and the C++ engine can speak it.
 from __future__ import annotations
 
 import dataclasses
+import io
 import json
 
 import numpy as np
+
+from ..utils.atomicio import atomic_replace_bytes
 
 #: engine-side stats fields, in wire order
 ENGINE_STAT_FIELDS = (
@@ -222,9 +225,10 @@ def write_paths_file(path: str, nodes: np.ndarray, plen: np.ndarray) -> None:
     ``ops.extract_paths``)."""
     nodes = np.asarray(nodes)
     plen = np.asarray(plen).reshape(-1, 1)
-    with open(path, "w") as f:
-        f.write(f"{nodes.shape[0]} {nodes.shape[1] - 1}\n")
-        np.savetxt(f, np.concatenate([plen, nodes], axis=1), fmt="%d")
+    buf = io.BytesIO()
+    buf.write(f"{nodes.shape[0]} {nodes.shape[1] - 1}\n".encode())
+    np.savetxt(buf, np.concatenate([plen, nodes], axis=1), fmt="%d")
+    atomic_replace_bytes(path, buf.getvalue())
 
 
 def read_paths_file(path: str) -> tuple[np.ndarray, np.ndarray]:
@@ -256,9 +260,10 @@ def write_results_file(path: str, cost: np.ndarray, plen: np.ndarray,
     cost = np.asarray(cost, np.int64)
     plen = np.asarray(plen, np.int64)
     fin = np.asarray(finished).astype(np.int64)
-    with open(path, "w") as f:
-        f.write(f"{len(cost)}\n")
-        np.savetxt(f, np.stack([cost, plen, fin], axis=1), fmt="%d")
+    buf = io.BytesIO()
+    buf.write(f"{len(cost)}\n".encode())
+    np.savetxt(buf, np.stack([cost, plen, fin], axis=1), fmt="%d")
+    atomic_replace_bytes(path, buf.getvalue())
 
 
 def read_results_file(path: str) -> tuple[np.ndarray, np.ndarray,
@@ -287,9 +292,10 @@ def read_results_file(path: str) -> tuple[np.ndarray, np.ndarray,
 def write_query_file(path: str, queries: np.ndarray) -> None:
     """count line, then ``s t`` per line (reference process_query.py:93-96)."""
     queries = np.asarray(queries)
-    with open(path, "w") as f:
-        f.write(f"{len(queries)}\n")
-        np.savetxt(f, queries, fmt="%d")
+    buf = io.BytesIO()
+    buf.write(f"{len(queries)}\n".encode())
+    np.savetxt(buf, queries, fmt="%d")
+    atomic_replace_bytes(path, buf.getvalue())
 
 
 def read_query_file(path: str) -> np.ndarray:

@@ -25,6 +25,7 @@ corrupted ``.npy`` header is caught exactly like corrupted payload.
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import io
 import json
@@ -104,6 +105,47 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
 
 def atomic_write_json(path: str, obj) -> None:
     atomic_write_bytes(path, (json.dumps(obj, indent=2) + "\n").encode())
+
+
+@contextlib.contextmanager
+def atomic_writer(path: str, mode: str = "w"):
+    """Streaming form of :func:`atomic_write_bytes`: yields the open
+    temp file so large artifacts (campaign CSVs) stream row by row in
+    constant memory, then fsync+rename on clean exit. An exception
+    removes the temp file — the final name never appears."""
+    tmp = f"{path}{TMP_SUFFIX}.{os.getpid()}"
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+    except BaseException:
+        f.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    f.close()
+    os.rename(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def atomic_replace_bytes(path: str, data: bytes) -> None:
+    """Atomic VISIBILITY without durability: tmp + rename, no fsync.
+
+    For transient data-plane files (per-batch query/results/paths wire
+    sidecars) that are deleted after one round trip: a concurrent
+    reader — or a timed-out batch's late writer racing a newer batch's
+    file — must never observe torn bytes, but the file outliving a
+    power cut is worthless, and an fsync pair per serving batch on a
+    shared NFS dir is a hot-path COMMIT round-trip. Durable artifacts
+    (index blocks, manifests, ledgers, campaign outputs) keep using
+    :func:`atomic_write_bytes`."""
+    tmp = f"{path}{TMP_SUFFIX}.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.rename(tmp, path)
 
 
 def atomic_save_npy(path: str, arr: np.ndarray) -> str:

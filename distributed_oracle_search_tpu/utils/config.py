@@ -125,8 +125,10 @@ class ClusterConfig:
             return cls.from_dict(json.load(f))
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(self.to_dict(), f, indent=2)
+        # tmp+fsync+rename: a conf is a durable artifact every worker
+        # and campaign reads — never observable torn
+        from .atomicio import atomic_write_json
+        atomic_write_json(path, self.to_dict())
 
 
 def test_config(datadir: str = "./data", n_workers: int = 8,

@@ -26,3 +26,35 @@ def env_cast(name: str, default, cast):
         log.warning("ignoring malformed %s=%r (using %r)", name, raw,
                     default)
         return default
+
+
+def env_str(name: str, default: str | None = None) -> str | None:
+    """Raw string knob (paths, host names, fault specs). Same policy
+    home as :func:`env_cast` so ``dos-lint``'s ``env-discipline`` rule
+    has one module to point every ``DOS_*`` read at."""
+    return os.environ.get(name, default)
+
+
+#: accepted spellings for boolean knobs; anything else is malformed and
+#: degrades to the default (logged), matching the env_cast policy
+_TRUE = frozenset(("1", "true", "yes", "on"))
+_FALSE = frozenset(("0", "false", "no", "off"))
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean knob. Historically these were parsed ad hoc (``!= "0"``
+    for default-on knobs, ``== "1"`` for default-off ones) with a
+    different accident waiting at each call site; one parser, one
+    degrade path. An EMPTY value counts as absent, not false — the
+    ``FLAG=${UNSET_VAR}`` shell-interpolation accident must not
+    silently flip a default-on knob off."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    v = raw.strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    log.warning("ignoring malformed %s=%r (using %r)", name, raw, default)
+    return default

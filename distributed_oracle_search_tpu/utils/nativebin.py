@@ -10,11 +10,13 @@ from __future__ import annotations
 
 import os
 
+from .env import env_str
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 SEARCH_DIRS = (
-    os.environ.get("DOS_NATIVE_BIN", ""),
+    env_str("DOS_NATIVE_BIN", ""),
     os.path.join(_REPO_ROOT, "bin"),
     os.path.join(_REPO_ROOT, "native", "build", "fast", "bin"),
     os.path.join(_REPO_ROOT, "native", "build", "dev", "bin"),

@@ -36,6 +36,7 @@ from ..transport.wire import (
 )
 from ..transport.fifo import command_fifo_path
 from ..utils.config import ClusterConfig
+from ..utils.env import env_cast
 from ..utils.log import get_logger, set_verbosity, set_worker_id
 from .engine import ShardEngine
 
@@ -336,10 +337,7 @@ class FifoServer:
         """How long to wait for the head to open its answer-FIFO reader.
         Read lazily (not at import) so tests/monkeypatched env work; a
         malformed value falls back to the default instead of crashing."""
-        try:
-            v = float(os.environ.get("DOS_REPLY_DEADLINE_S", "30"))
-        except ValueError:
-            return 30.0
+        v = env_cast("DOS_REPLY_DEADLINE_S", 30.0, float)
         # a zero/negative deadline would drop every reply whose reader
         # has not already opened — same guard as the native server's
         return v if v > 0 else 30.0

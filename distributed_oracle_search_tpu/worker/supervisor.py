@@ -41,6 +41,7 @@ from ..obs import metrics as obs_metrics
 from ..transport import fifo as fifo_transport
 from ..utils.config import ClusterConfig
 from ..utils.env import env_cast
+from ..utils.locks import OrderedLock
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
@@ -118,7 +119,7 @@ class WorkerSupervisor:
                         for wid in wids}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("supervisor.WorkerSupervisor")
 
     # --------------------------------------------------------- defaults
     def _fifo_for(self, wid: int) -> str:

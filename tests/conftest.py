@@ -14,6 +14,11 @@ import os
 # JAX_PLATFORMS env var). Re-override via jax.config before any backend
 # initializes; tests always run on the virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# arm the runtime lock-order detector for the whole suite: every
+# threaded serving/replication/obs test doubles as a lock-order
+# regression check (utils.locks witness graph; cycles raise at the
+# acquire that would make deadlock possible)
+os.environ.setdefault("DOS_LOCK_CHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -41,6 +46,16 @@ import pytest
 from distributed_oracle_search_tpu.data import synth_city_graph, synth_scenario
 from distributed_oracle_search_tpu.obs import metrics as obs_metrics
 from distributed_oracle_search_tpu.testing import faults
+from distributed_oracle_search_tpu.utils import locks as dos_locks
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _no_lock_order_cycles():
+    """The witness graph must stay acyclic across the WHOLE run: in
+    warn mode (or if a raise was swallowed by a worker thread) the
+    session still fails with the recorded violation list."""
+    yield
+    assert dos_locks.violations() == [], dos_locks.violations()
 
 
 @pytest.fixture(scope="session")

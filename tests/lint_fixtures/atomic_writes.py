@@ -1,0 +1,24 @@
+"""dos-lint fixture: atomic-writes."""
+
+import json
+
+from distributed_oracle_search_tpu.utils.atomicio import atomic_write_json
+
+
+def bad_manifest_write(dirname, manifest):
+    path = dirname + "/index-manifest.json"
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+
+
+def suppressed_write(dirname):
+    # dos-lint: disable=atomic-writes -- fixture: scratch file, a torn
+    #   write is rebuilt from source on the next run
+    with open(dirname + "/scratch.json", "w") as f:
+        f.write("{}")
+
+
+def clean_write(dirname, manifest):
+    atomic_write_json(dirname + "/index-manifest.json", manifest)
+    with open(dirname + "/notes.txt", "w") as f:
+        f.write("non-durable: no artifact suffix, plain open is fine")

@@ -1,0 +1,9 @@
+"""dos-lint fixture: a disable comment without a justification is
+itself a finding and silences nothing."""
+
+import os
+
+
+def bad_unjustified(fifo_path):
+    # dos-lint: disable=fifo-hygiene
+    return open(fifo_path, "r")

@@ -1,0 +1,19 @@
+"""dos-lint fixture: env-discipline."""
+
+import os
+
+from distributed_oracle_search_tpu.utils.env import env_cast
+
+
+def bad_direct_read():
+    return os.environ.get("DOS_FIXTURE_KNOB", "1")
+
+
+def suppressed_read():
+    # dos-lint: disable=env-discipline -- fixture: exercising the
+    #   suppression path of the checker itself
+    return os.getenv("DOS_FIXTURE_KNOB")
+
+
+def clean_read():
+    return env_cast("DOS_FIXTURE_KNOB", 1, int)

@@ -1,0 +1,17 @@
+"""dos-lint fixture: fifo-hygiene."""
+
+import os
+
+
+def bad_blocking_open(fifo_path):
+    return os.open(fifo_path, os.O_WRONLY)
+
+
+def suppressed_blocking_open(fifo_path):
+    # dos-lint: disable=fifo-hygiene -- fixture: peer lifetime pinned
+    #   by the test harness, open cannot wedge
+    return open(fifo_path, "r")
+
+
+def clean_bounded_open(fifo_path):
+    return os.open(fifo_path, os.O_WRONLY | os.O_NONBLOCK)

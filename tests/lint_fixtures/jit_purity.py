@@ -1,0 +1,35 @@
+"""dos-lint fixture: jit-purity."""
+
+import time
+
+import jax
+
+_captured = []
+
+
+@jax.jit
+def bad_traced_sleep(x):
+    time.sleep(0.001)
+    return x + 1
+
+
+@jax.jit
+def bad_captured_mutation(x):
+    _captured.append(x)
+    return x + 1
+
+
+@jax.jit
+def suppressed_sleep(x):
+    # dos-lint: disable=jit-purity -- fixture: trace-time delay wanted
+    #   to exercise the suppression path
+    time.sleep(0.001)
+    return x + 1
+
+
+@jax.jit
+def clean_pure(x):
+    y = x * 2
+    local = [y]
+    local.append(y + 1)
+    return local[0] + local[1]

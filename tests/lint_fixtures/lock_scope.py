@@ -1,0 +1,25 @@
+"""dos-lint fixture: lock-scope."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def bad_sleep_under_lock():
+    with _lock:
+        time.sleep(0.01)
+
+
+def suppressed_sleep_under_lock():
+    with _lock:
+        # dos-lint: disable=lock-scope -- fixture: bounded pause held
+        #   deliberately to exercise the suppression path
+        time.sleep(0.01)
+
+
+def clean_sleep_outside():
+    with _lock:
+        x = 1 + 1
+    time.sleep(0.01)
+    return x

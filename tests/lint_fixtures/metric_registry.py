@@ -1,0 +1,14 @@
+"""dos-lint fixture: metric-registry."""
+
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+
+M_BAD = obs_metrics.counter(
+    "fixture_lonely_total", "counter missing from the obs metric map")
+
+# dos-lint: disable=metric-registry -- fixture: exercising the
+#   suppression path of the checker itself
+M_SUPPRESSED = obs_metrics.counter(
+    "fixture_suppressed_total", "suppressed undocumented counter")
+
+M_CLEAN = obs_metrics.counter(
+    "serve_requests_total", "documented name, correct suffix")

@@ -1,0 +1,39 @@
+"""dos-lint fixture: silent-except."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def _risky():
+    raise RuntimeError("fixture")
+
+
+def bad_swallow():
+    try:
+        _risky()
+    except Exception:
+        return None
+
+
+def suppressed_swallow():
+    try:
+        _risky()
+    except Exception:  # dos-lint: disable=silent-except -- fixture:
+        # exercising the suppression path of the checker itself
+        pass
+
+
+def clean_logged():
+    try:
+        _risky()
+    except Exception as e:
+        log.warning("risky failed: %s", e)
+        return None
+
+
+def clean_error_as_data():
+    try:
+        _risky()
+    except Exception as e:
+        return {"error": str(e)}

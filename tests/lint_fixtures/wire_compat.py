@@ -1,0 +1,39 @@
+"""dos-lint fixture: wire-compat."""
+
+import dataclasses
+import json
+
+VERSION = 2
+
+
+@dataclasses.dataclass
+class Msg:
+    a: int = 0
+
+
+def bad_from_json(line):
+    d = json.loads(line)
+    return Msg(**d)
+
+
+def bad_version_gate(d):
+    def parse_header(h):
+        if h["version"] != VERSION:
+            raise ValueError("unsupported")
+        return h
+    return parse_header(d)
+
+
+def suppressed_from_json(line):
+    d = json.loads(line)
+    # dos-lint: disable=wire-compat -- fixture: strict legacy codec
+    #   kept for byte-parity tests
+    return Msg(**d)
+
+
+def clean_from_json(line):
+    d = json.loads(line)
+    if d.get("version", 1) > VERSION:
+        raise ValueError("newer than this reader; refusing to misread")
+    known = {f.name for f in dataclasses.fields(Msg)}
+    return Msg(**{k: v for k, v in d.items() if k in known})

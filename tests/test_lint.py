@@ -1,0 +1,226 @@
+"""``dos-lint`` analyzer suite: the fixture corpus proves every rule
+fires (positive + suppressed + clean per rule), the self-check proves
+the real package passes ``--strict`` with zero unsuppressed findings,
+and the CLI tests pin the bench-diff exit-code convention."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import distributed_oracle_search_tpu
+from distributed_oracle_search_tpu.analysis import (
+    ALL_RULES, BAD_SUPPRESSION, LintConfig, render_json, run_paths,
+)
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+PACKAGE = os.path.dirname(
+    os.path.abspath(distributed_oracle_search_tpu.__file__))
+REPO = os.path.dirname(PACKAGE)
+
+RULE_NAMES = [r.name for r in ALL_RULES]
+
+
+def lint(paths, **cfg):
+    findings, n = run_paths(paths, ALL_RULES, LintConfig(**cfg))
+    return findings
+
+
+def _clean_line(path) -> int:
+    """Line of the first ``clean``-prefixed def/assign in a fixture —
+    findings at or after it would be false positives."""
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            if line.startswith(("def clean", "M_CLEAN")):
+                return i
+    raise AssertionError(f"no clean case in {path}")
+
+
+# ------------------------------------------------------- fixture corpus
+
+@pytest.mark.parametrize("rule", [r for r in RULE_NAMES])
+def test_rule_fires_and_suppresses(rule):
+    path = os.path.join(FIXTURES, rule.replace("-", "_") + ".py")
+    findings = [f for f in lint([path], select=(rule,))
+                if f.rule == rule]
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    assert active, f"{rule}: positive case did not fire"
+    assert suppressed, f"{rule}: suppressed case did not register"
+    for f in suppressed:
+        assert f.justification, f"{rule}: suppression lost its reason"
+    clean_at = _clean_line(path)
+    late = [f for f in findings if f.line >= clean_at]
+    assert late == [], f"{rule}: clean case flagged: {late}"
+
+
+def test_corpus_strict_fails_with_every_rule():
+    findings = lint([FIXTURES])
+    fired = {f.rule for f in findings if not f.suppressed}
+    assert set(RULE_NAMES) <= fired, sorted(set(RULE_NAMES) - fired)
+    assert BAD_SUPPRESSION in fired
+
+
+def test_bad_suppression_is_finding_and_does_not_silence():
+    path = os.path.join(FIXTURES, "bad_suppression.py")
+    findings = lint([path])
+    rules = {f.rule: f.suppressed for f in findings}
+    assert rules.get(BAD_SUPPRESSION) is False
+    # the justification-less disable silenced nothing
+    assert rules.get("fifo-hygiene") is False
+
+
+def test_suppression_needs_matching_rule(tmp_path):
+    p = tmp_path / "wrong_rule.py"
+    p.write_text(
+        "import os\n\n\n"
+        "def f():\n"
+        "    # dos-lint: disable=lock-scope -- wrong rule named here\n"
+        "    return os.getenv(\"DOS_X\")\n")
+    findings = lint([str(p)])
+    env = [f for f in findings if f.rule == "env-discipline"]
+    assert env and not env[0].suppressed
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = lint([str(p)])
+    assert [f.rule for f in findings] == ["syntax-error"]
+
+
+def test_null_byte_file_is_a_finding_not_a_crash(tmp_path):
+    """ast.parse raises ValueError (not SyntaxError) on a null byte;
+    one corrupt file must not take down the whole gate."""
+    p = tmp_path / "stray.py"
+    p.write_bytes(b"x = 1\x00")
+    findings = lint([str(p)])
+    assert [f.rule for f in findings] == ["syntax-error"]
+
+
+def test_trailing_suppression_covers_multiline_statement(tmp_path):
+    """A finding anchors to a multi-line statement's FIRST line; a
+    trailing disable comment on a later physical line must still
+    cover it."""
+    p = tmp_path / "multiline.py"
+    p.write_text(
+        "import os\n\n"
+        "x = os.environ.get(\n"
+        "    \"DOS_X\")  # dos-lint: disable=env-discipline -- why not\n")
+    findings = lint([str(p)])
+    env = [f for f in findings if f.rule == "env-discipline"]
+    assert env and env[0].suppressed and env[0].justification
+
+
+def test_suppression_inside_body_cannot_reach_the_header(tmp_path):
+    """A disable trailing a line INSIDE a with/if body must not silence
+    a finding anchored at the compound statement's header."""
+    p = tmp_path / "scoped.py"
+    p.write_text(
+        "import os\n\n"
+        "def write_out(d):\n"
+        "    with open(d + \"/outer.json\", \"w\") as f:\n"
+        "        x = 1  # dos-lint: disable=atomic-writes -- unrelated\n"
+        "        f.write(str(x))\n")
+    findings = lint([str(p)])
+    aw = [f for f in findings if f.rule == "atomic-writes"]
+    assert aw and not aw[0].suppressed
+
+
+def test_stacked_disable_lines_both_apply(tmp_path):
+    p = tmp_path / "stacked.py"
+    p.write_text(
+        "import os\n\n\n"
+        "def write_out(d):\n"
+        "    # dos-lint: disable=env-discipline -- reason one\n"
+        "    # dos-lint: disable=atomic-writes -- reason two\n"
+        "    open(d + \"/out.json\", \"w\").write("
+        "os.environ.get(\"DOS_Y\", \"\"))\n")
+    findings = lint([str(p)])
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["env-discipline"].suppressed
+    assert by_rule["atomic-writes"].suppressed
+
+
+# ----------------------------------------------------------- self-check
+
+def test_package_is_lint_clean():
+    """THE gate: zero unsuppressed findings on the real package, and
+    every suppression carries a justification."""
+    findings = lint([PACKAGE])
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], "\n".join(f.render() for f in active)
+    suppressed = [f for f in findings if f.suppressed]
+    assert suppressed, "expected the documented real-code suppressions"
+    for f in suppressed:
+        assert f.justification.strip(), f.render()
+
+
+def test_console_script_strict_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_oracle_search_tpu.cli.lint", "--strict", PACKAGE],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_console_script_strict_fails_on_corpus():
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_oracle_search_tpu.cli.lint", "--strict", FIXTURES],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+# -------------------------------------------- bench-diff gate convention
+
+def test_json_report_gate_convention():
+    """``--json`` mirrors ``dos-obs bench-diff``: ok/exit_code in the
+    doc, process exit 1 on findings / 0 clean — the two gates compose
+    in one pipeline."""
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_oracle_search_tpu.cli.lint", "--json", FIXTURES],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    doc = json.loads(proc.stdout)
+    assert proc.returncode == 1
+    assert doc["ok"] is False and doc["exit_code"] == 1
+    assert set(RULE_NAMES) <= set(doc["counts"])
+    assert doc["suppressed"] > 0
+
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_oracle_search_tpu.cli.lint", "--json", PACKAGE],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    doc = json.loads(proc.stdout)
+    assert proc.returncode == 0
+    assert doc["ok"] is True and doc["exit_code"] == 0
+    assert doc["counts"] == {}
+
+
+def test_render_json_matches_cli_fields():
+    findings = lint([os.path.join(FIXTURES, "env_discipline.py")])
+    doc = render_json(findings, 1)
+    assert {"ok", "exit_code", "files", "counts", "suppressed",
+            "findings"} <= set(doc)
+
+
+def test_unknown_rule_is_usage_error():
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_oracle_search_tpu.cli.lint", "--select", "bogus"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_select_and_disable_scope_rules():
+    path = os.path.join(FIXTURES, "env_discipline.py")
+    only_lock = lint([path], select=("lock-scope",))
+    assert [f for f in only_lock if f.rule == "env-discipline"] == []
+    disabled = lint([path], disable=("env-discipline",))
+    assert [f for f in disabled if f.rule == "env-discipline"] == []

@@ -1,0 +1,239 @@
+"""``utils.locks`` runtime lock-order detector suite.
+
+The unit tests drive a private witness graph so deliberate cycles never
+pollute the process-wide one (the session fixture asserts THAT graph
+stays clean — tier-1's threaded serving/replication/obs tests run with
+``DOS_LOCK_CHECK=1`` and double as the continuous regression check).
+"""
+
+import threading
+
+import pytest
+
+from distributed_oracle_search_tpu.utils import locks
+from distributed_oracle_search_tpu.utils.locks import (
+    LockOrderError, OrderedLock, _WitnessGraph,
+)
+
+pytestmark = pytest.mark.lint
+
+
+@pytest.fixture
+def graph():
+    return _WitnessGraph()
+
+
+@pytest.fixture(autouse=True)
+def _checking():
+    """Force raise-mode for these tests regardless of the env, and
+    restore afterwards."""
+    prev = locks.set_checking("raise")
+    yield
+    locks.set_checking(prev)
+
+
+def test_consistent_order_is_silent(graph):
+    a = OrderedLock("t.A", graph)
+    b = OrderedLock("t.B", graph)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert graph.violations() == []
+    assert "t.B" in graph.edges()["t.A"]
+
+
+def test_abba_cycle_raises_without_deadlocking(graph):
+    """The witness property: one thread exercising A->B then B->A is
+    enough — no adversarial interleaving needed."""
+    a = OrderedLock("t.A", graph)
+    b = OrderedLock("t.B", graph)
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderError, match="cycle"):
+        with b:
+            with a:
+                pass
+    assert graph.violations()
+
+
+def test_longer_cycle_detected_through_the_graph(graph):
+    a, b, c = (OrderedLock(n, graph) for n in ("t.A", "t.B", "t.C"))
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(LockOrderError, match="t.A"):
+        with c:
+            with a:
+                pass
+
+
+def test_self_deadlock_raises(graph):
+    a = OrderedLock("t.A", graph)
+    with a:
+        with pytest.raises(LockOrderError, match="self-deadlock"):
+            a.acquire()
+
+
+def test_same_name_different_instances_flagged(graph):
+    """Two locks of the same CLASS nested = instance-order ambiguity,
+    the ABBA seed the per-name graph cannot prove safe."""
+    a1 = OrderedLock("t.Peer", graph)
+    a2 = OrderedLock("t.Peer", graph)
+    with a1:
+        with pytest.raises(LockOrderError):
+            a2.acquire()
+
+
+def test_warn_mode_self_deadlock_still_raises(graph):
+    """warn downgrades ORDER cycles only: a same-instance re-acquire
+    is deadlock CERTAIN — proceeding would block the thread forever
+    with one log line as evidence, so it raises in every mode."""
+    locks.set_checking("warn")
+    a = OrderedLock("t.A", graph)
+    with a:
+        with pytest.raises(LockOrderError, match="self-deadlock"):
+            a.acquire()
+
+
+def test_warn_mode_records_without_raising(graph):
+    locks.set_checking("warn")
+    a = OrderedLock("t.A", graph)
+    b = OrderedLock("t.B", graph)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass        # no raise
+    assert any("cycle" in v for v in graph.violations())
+
+
+def test_off_mode_is_a_plain_lock(graph):
+    locks.set_checking(False)
+    a = OrderedLock("t.A", graph)
+    b = OrderedLock("t.B", graph)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert graph.violations() == []
+    assert graph.edges() == {}
+
+
+def test_mode_flip_mid_hold_does_not_strand_stack(graph):
+    """set_checking() flipped between a thread's acquire and release
+    must not leave a stale held-stack entry that later reads as a
+    false self-deadlock."""
+    a = OrderedLock("t.Flip", graph)
+    a.acquire()
+    locks.set_checking(False)
+    a.release()                 # mode off: pop must still happen
+    locks.set_checking("raise")
+    with a:                     # would raise self-deadlock if stranded
+        pass
+    assert graph.violations() == []
+
+
+def test_out_of_order_release_is_fine(graph):
+    a = OrderedLock("t.A", graph)
+    b = OrderedLock("t.B", graph)
+    a.acquire()
+    b.acquire()
+    a.release()
+    b.release()
+    assert graph.violations() == []
+
+
+def test_nonblocking_acquire_contended():
+    lock = OrderedLock("t.NB", _WitnessGraph())
+    got = lock.acquire(blocking=False)
+    assert got
+    holder = {}
+
+    def try_other():
+        holder["got"] = lock.acquire(blocking=False)
+
+    t = threading.Thread(target=try_other)
+    t.start()
+    t.join()
+    assert holder["got"] is False
+    lock.release()
+
+
+def test_ordered_condition_wait_notify(graph):
+    """Condition integration: wait() releases through OrderedLock (the
+    held stack stays truthful), _is_owned answers from the stack, and
+    no violation is recorded."""
+    cond = threading.Condition(OrderedLock("t.Cond", graph))
+    state = {"ready": False, "seen": False}
+
+    def waiter():
+        with cond:
+            while not state["ready"]:
+                cond.wait(timeout=5.0)
+            state["seen"] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cond:
+        state["ready"] = True
+        cond.notify()
+    t.join(timeout=5.0)
+    assert state["seen"]
+    assert graph.violations() == []
+
+
+def test_detector_is_live_in_the_real_stack():
+    """Regression guard for the adopted lock sites: drive the serving
+    queue (condition -> metrics-gauge edge) and the breaker registry
+    and assert the PROCESS-WIDE witness graph saw those edges — proof
+    tier-1's threaded tests are actually running under the detector,
+    not silently in no-op mode."""
+    from distributed_oracle_search_tpu.serving.queue import ShardQueue
+    from distributed_oracle_search_tpu.serving.request import ServeRequest
+    from distributed_oracle_search_tpu.transport.resilience import (
+        BreakerRegistry,
+    )
+
+    q = ShardQueue(4)
+    q.try_put(ServeRequest(s=0, t=1, wid=0, key=(0, 1, "-", ()),
+                           t_submit=0.0, deadline=1e9))
+    q.get_batch(4, 0.0, threading.Event())
+    reg = BreakerRegistry(threshold=1, enabled=True)
+    reg.record((0, "h"), True)
+    edges = locks.GRAPH.edges()
+    assert "metrics.Gauge" in edges.get("serving.ShardQueue", set())
+    assert locks.violations() == []
+
+
+def test_hedge_breaker_lane_interaction_acyclic():
+    """The ISSUE's prime suspect: hedge-tracker vs breaker-registry vs
+    dispatcher lane locks. Exercise the same nesting the frontend's
+    hedged dispatch path uses and assert the witness graph stays
+    acyclic (the runtime detector found NO real ordering cycle in the
+    adopted sites — this pins that)."""
+    from distributed_oracle_search_tpu.serving.hedge import (
+        HedgeConfig, HedgeTracker,
+    )
+    from distributed_oracle_search_tpu.transport.resilience import (
+        BreakerRegistry,
+    )
+
+    tracker = HedgeTracker(HedgeConfig(enabled=True, budget=1.0))
+    reg = BreakerRegistry(threshold=1, enabled=True)
+    for wid in (0, 1):
+        key = (wid, "h")
+        assert reg.allow(key)
+        tracker.observe(wid, 0.01)
+        tracker.try_issue()
+        reg.record(key, wid == 0)   # one success, one failure -> OPEN
+    assert reg.available((1, "h")) in (True, False)
+    reg.shutdown()
+    assert locks.violations() == []
