@@ -32,6 +32,9 @@ grid + arterials; see ``data/synth.py``). Sections (env-gated):
   replication  R=2 failover drill — q/s + p99 with and without one
              killed primary (breaker forced open), plus hedge win rate
              under an injected primary delay          (BENCH_REPL=0 skips)
+  reshard    elastic-membership drill — serve q/s + p99 steady vs
+             through a LIVE worker join (dual-read migration window,
+             epoch bump committed mid-load)        (BENCH_RESHARD=0 skips)
 
 All speedups are against a MEASURED native-engine run on this host's
 cpu_cores core(s); *_parity_cores fields give the OpenMP core count a
@@ -1747,6 +1750,111 @@ def main() -> None:
             f"hedge rate {repl_stats['repl_hedge_rate']:.2f}")
         shutil.rmtree(rdir, ignore_errors=True)
 
+    # ---- reshard section: serve q/s + p99 through a LIVE worker join
+    # (the elastic-membership dual-read window) vs the steady fleet.
+    # A 2-worker world gains a third worker mid-load: begin opens the
+    # window, catch_up adopts a shard, commit bumps the epoch — the
+    # drill measures what the migration window costs the open workload.
+    # BENCH_RESHARD=0 skips.
+    reshard_stats = {}
+    if os.environ.get("BENCH_RESHARD", "1") != "0":
+        from distributed_oracle_search_tpu.data import (
+            ensure_synth_dataset, read_scen,
+        )
+        from distributed_oracle_search_tpu.data.graph import Graph
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.parallel import (
+            membership as _fleet,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            EngineDispatcher, HedgeConfig, ServeConfig, ServingFrontend,
+        )
+        from distributed_oracle_search_tpu.transport.wire import (
+            RuntimeConfig,
+        )
+        from distributed_oracle_search_tpu.utils.config import (
+            ClusterConfig,
+        )
+
+        log("reshard (serve q/s through a live worker join)...")
+        edir = tempfile.mkdtemp(prefix="bench-reshard-")
+        epaths = ensure_synth_dataset(edir, width=24, height=18,
+                                      n_queries=512, seed=37)
+        econf = ClusterConfig(
+            workers=["localhost"] * 2, partmethod="mod", partkey=2,
+            outdir=os.path.join(edir, "index"),
+            xy_file=epaths["xy"], scenfile=epaths["scen"],
+            nfs=edir).validate()
+        eg = Graph.from_xy(econf.xy_file)
+        edc = DistributionController("mod", 2, 2, eg.n)
+        for wid in range(2):
+            build_worker_shard(eg, edc, wid, econf.outdir)
+        write_index_manifest(econf.outdir, edc)
+        equeries = read_scen(econf.scenfile)
+        en = int(os.environ.get("BENCH_RESHARD_REQUESTS", 512))
+        epool = equeries[np.arange(en) % len(equeries)]
+        mc = _fleet.MembershipController(econf, edc, graph=eg)
+        disp = EngineDispatcher(econf, graph=eg, dc=edc)
+        for wid in range(2):     # warm the engines off the clock
+            mine = equeries[edc.worker_of(equeries[:, 1]) == wid][:64]
+            disp.answer_batch(wid, mine, RuntimeConfig(), "-")
+
+        def _edrill(tag, during=None):
+            """Closed-loop drill; ``during`` optionally runs the
+            migration steps between the submit stream's halves so the
+            window is genuinely live while queries flow."""
+            fe = ServingFrontend(
+                mc.dc_view(), disp,
+                sconf=ServeConfig(max_batch=64, max_wait_ms=2.0,
+                                  queue_depth=max(en, 1024),
+                                  cache_bytes=0,
+                                  deadline_ms=600_000.0),
+                hconf=HedgeConfig(enabled=False), membership=mc)
+            fe.start()
+            t0 = time.perf_counter()
+            submits, futs = [], []
+            for i, (s, t) in enumerate(epool):
+                if during is not None and i == len(epool) // 2:
+                    during()
+                submits.append(time.monotonic())
+                futs.append(fe.submit(int(s), int(t)))
+            res = [f.result(600) for f in futs]
+            wall = time.perf_counter() - t0
+            fe.stop()
+            n_ok = sum(r.ok for r in res)
+            lat = [(r.t_done - ts) * 1e3
+                   for r, ts in zip(res, submits) if r.ok]
+            p99 = float(np.percentile(lat, 99)) if lat else float("nan")
+            log(f"  {tag}: {n_ok}/{en} ok in {wall:.2f}s "
+                f"({n_ok / wall:,.0f} q/s, p99 {p99:.1f} ms)")
+            return n_ok, wall, p99
+
+        ok_st, wall_st, p99_st = _edrill("steady (epoch 0)")
+
+        def _join_now():
+            mig = mc.begin(mc.plan_join("localhost"), host="localhost")
+            mc.catch_up(mig)
+            mc.commit(mig)
+
+        ok_mg, wall_mg, p99_mg = _edrill("migrating (live join)",
+                                         during=_join_now)
+        reshard_stats = {
+            "reshard_steady_queries_per_sec": round(ok_st / wall_st, 1),
+            "reshard_steady_p99_ms": round(p99_st, 3),
+            "reshard_migrating_queries_per_sec": round(
+                ok_mg / wall_mg, 1),
+            "reshard_migrating_p99_ms": round(p99_mg, 3),
+            "reshard_epoch_after": int(mc.epoch),
+        }
+        log(f"reshard: steady "
+            f"{reshard_stats['reshard_steady_queries_per_sec']:,.0f} "
+            f"q/s -> migrating "
+            f"{reshard_stats['reshard_migrating_queries_per_sec']:,.0f}"
+            f" q/s (epoch {mc.epoch} committed, {ok_mg}/{en} ok)")
+        shutil.rmtree(edir, ignore_errors=True)
+
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     detail = {
         "graph_nodes": g.n,
@@ -1793,6 +1901,7 @@ def main() -> None:
         **weak_stats,
         **serve_stats,
         **repl_stats,
+        **reshard_stats,
         "devices": len(devices),
         "platform": devices[0].platform,
     }

@@ -43,6 +43,7 @@ from ..transport.wire import (
     Request, RuntimeConfig, STATS_HEADER, StatsRow, paths_file_for,
     read_paths_file, write_query_file,
 )
+from ..parallel import membership as fleet
 from ..parallel.multihost import is_primary
 from ..transport import fifo as fifo_transport
 from ..transport import resilience
@@ -489,12 +490,22 @@ def send_queries(host: str, wid: int, part: np.ndarray, rconf: RuntimeConfig,
 
     def _attempt(key):
         c_host, c_wid = key
-        # a failed-over batch must NOT share the replica's primary
-        # file/FIFO names: shard w's re-routed batch and the replica's
+        # a re-routed batch must NOT share another batch's file/FIFO
+        # names: shard w's failed-over batch and the serving worker's
         # OWN batch run concurrently in the same round, and a shared
         # `query.<host><wid>` / `answer.<host><wid>` pair would tear.
-        # The primary attempt keeps the legacy names byte-for-byte.
-        suffix = "" if key == candidates[0] else f".s{wid}"
+        # Bare names are reserved for the c_wid == wid case (worker id
+        # doubles as shard id — the legacy invariant, byte-for-byte);
+        # any other (shard, worker) pairing suffixes the SHARD id, so
+        # two shards owned by one worker after an elastic epoch can
+        # never collide on the primary name. The suffix always carries
+        # `.e<epoch>` (epoch 0 included — the first migration window
+        # opens BEFORE the first bump): a dual-read window's files are
+        # attributable to their table version, and an aborted window's
+        # debris is collectible by the campaign-start epoch sweep
+        # (transport.fifo.clean_stale_epoch_files)
+        epoch = getattr(rconf, "epoch", 0)
+        suffix = "" if c_wid == wid else f".s{wid}.e{epoch}"
         with Timer() as prep, obs_trace.span("head.prepare", wid=c_wid,
                                              shard=wid,
                                              trace_id=trace_id):
@@ -566,17 +577,19 @@ def send_timeout_s(args) -> float:
 
 
 def run_host(conf: ClusterConfig, args, queries, dc, diffs,
-             t_partition: float = 0.0):
+             t_partition: float = 0.0, mstate=None):
     rconf = runtime_config(args)
     groups = dc.group_queries(queries, active_worker=args.worker)
     timeout = send_timeout_s(args)
     # fault-tolerance plumbing: stale FIFOs from crashed runs are swept
     # before the first batch (a killed transfer script never reaches its
     # `rm -f`), stale build artifacts (*.tmp debris, quarantined blocks)
-    # go with them, retries follow the env-tuned backoff policy, and
+    # and epoch-suffixed wire files from an aborted migration window go
+    # with them, retries follow the env-tuned backoff policy, and
     # each worker gets a circuit breaker whose background probes ping
     # through the same command FIFO the batches use
     fifo_transport.clean_stale_answer_fifos(conf.nfs)
+    fifo_transport.clean_stale_epoch_files(conf.nfs)
     sweep_stale_artifacts(conf.outdir)
     policy = fifo_transport.RetryPolicy.from_env()
     registry = resilience.BreakerRegistry(
@@ -594,7 +607,7 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
     try:
         stats, paths, failures = _run_host_rounds(
             conf, args, dc, diffs, groups, rconf, t_partition, timeout,
-            tracing, base_tid, policy, registry)
+            tracing, base_tid, policy, registry, mstate=mstate)
     finally:
         registry.shutdown()
     if failures:
@@ -604,21 +617,98 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
     return stats, paths, failures
 
 
+def _round_membership(conf, dc, last=None):
+    """One round's live routing view: the durable membership state (or
+    None on a static fleet), the matching controller, the host roster,
+    and the round's epoch-stamped knobs. Re-read EVERY round so a
+    reconfiguration committed mid-campaign flips the very next round's
+    routing — this is what makes a campaign survive a live join/leave
+    without draining.
+
+    ``last`` is the previous round's (state, controller, roster)
+    triple: a read that fails — or a state file that VANISHES after an
+    elastic view was already in effect — degrades to that last-good
+    view, never to a mix. The table and the roster must come from the
+    same state: ``dc`` may already carry a committed owner table whose
+    joined worker ids are past the static conf roster, and pairing it
+    with ``conf.workers`` would wrap those ids onto the wrong hosts."""
+    try:
+        mview = fleet.load_state(conf.outdir)
+    except ValueError as e:
+        if last is not None:
+            log.error("membership state unreadable (%s); keeping the "
+                      "previous round's table", e)
+            return last
+        log.error("membership state unreadable (%s); keeping the "
+                  "current table", e)
+        mview = None
+    if (mview is None and last is not None and last[0] is not None):
+        log.error("membership state vanished; keeping the previous "
+                  "round's table")
+        return last
+    if last is not None and last[0] is not None and mview is not None:
+        if mview.epoch < last[0].epoch:
+            # epochs are monotone: a lagging read (NFS cache, a
+            # restored stale file) must not roll routing back to a
+            # drained owner — the refresh()/worker-gate rule
+            log.error("membership state read epoch %d behind round's "
+                      "%d; keeping the previous round's table",
+                      mview.epoch, last[0].epoch)
+            return last
+        if mview.to_dict() == last[0].to_dict():
+            # unchanged: reuse the controller instead of re-running
+            # the O(N) node assignment every round
+            return last
+    try:
+        dc_r = fleet.apply_state(dc, mview) if mview is not None else dc
+    except ValueError as e:
+        # an owners table that does not fit this partition (conf
+        # mismatch, hand edit) degrades instead of crashing the round
+        if last is not None:
+            log.error("membership state does not apply (%s); keeping "
+                      "the previous round's table", e)
+            return last
+        log.error("membership state does not apply (%s); keeping the "
+                  "static table", e)
+        mview, dc_r = None, dc
+    hosts = (list(mview.workers) if mview is not None and mview.workers
+             else list(conf.workers))
+    return mview, dc_r, hosts
+
+
 def _run_host_rounds(conf, args, dc, diffs, groups, rconf, t_partition,
-                     timeout, tracing, base_tid, policy, registry):
+                     timeout, tracing, base_tid, policy, registry,
+                     mstate=None):
     stats = []
     paths = None
     failures = []
+    # last-good (state, table, roster) triple: seeded from the startup
+    # view so even a ROUND-0 read failure under an elastic table keeps
+    # the roster that names the joined workers' hosts
+    last = None
+    if mstate is not None:
+        last = (mstate, dc,
+                list(mstate.workers) if mstate.workers
+                else list(conf.workers))
     for di, diff in enumerate(diffs):
-        jobs = [(conf.workers[wid], wid, part) for wid, part in
-                sorted(groups.items())]
+        mview, dc_r, hosts = _round_membership(conf, dc, last=last)
+        last = (mview, dc_r, hosts)
+        rconf_r = (dataclasses.replace(rconf, epoch=dc_r.epoch)
+                   if dc_r.epoch else rconf)
+
+        def _host_of(c: int) -> str:
+            return hosts[c] if c < len(hosts) else hosts[c % len(hosts)]
+
+        jobs = [(_host_of(dc_r.owner_of(wid)), wid, part)
+                for wid, part in sorted(groups.items())]
         results = fan_out(jobs, lambda j: send_queries(
-            j[0], j[1], j[2], rconf, conf.nfs, diff,
+            j[0], j[1], j[2], rconf_r, conf.nfs, diff,
             t_partition=t_partition, timeout=timeout,
             trace_id=f"{base_tid}/w{j[1]}.d{di}" if tracing else "",
             round_idx=di, policy=policy, registry=registry,
-            candidates=[(conf.workers[c], c)
-                        for c in dc.replica_workers(j[1])]))
+            candidates=[(_host_of(c), c)
+                        for c in fleet.route_candidates(mview, dc_r,
+                                                        j[1])]))
         rows = [row for row, _failure, _served in results]
         failures.extend(f for _row, f, _served in results
                         if f is not None)
@@ -699,6 +789,20 @@ def run(conf: ClusterConfig, args):
                      conf.effective_replication())
         dc = DistributionController(partmethod, partkey, conf.maxworker,
                                     nodenum, replication=replication)
+        # elastic membership (host wire only, like replication: the
+        # in-process mesh has no per-worker placement to reassign): a
+        # committed epoch's owner table overrides the conf's static
+        # identity, and each round re-reads it so a reconfiguration
+        # committed mid-campaign flips the next round's routing
+        if not use_tpu:
+            mstate = fleet.load_state(conf.outdir)
+            if mstate is not None:
+                dc = fleet.apply_state(dc, mstate)
+                log.info("membership epoch %d in effect (%d worker(s) "
+                         "in roster)", dc.epoch, len(mstate.workers))
+        elif fleet.current_epoch(conf.outdir):
+            log.info("membership state ignored on the TPU backend "
+                     "(in-process mesh: placement is the mesh itself)")
     H_PARTITION.observe(t_workload.interval)
     diffs = list(conf.diffs) if conf.diffs else list(args.diffs)
     if use_tpu:
@@ -711,7 +815,7 @@ def run(conf: ClusterConfig, args):
         else:
             stats, paths, failures = run_host(
                 conf, args, queries, dc, diffs,
-                t_partition=t_workload.interval)
+                t_partition=t_workload.interval, mstate=mstate)
 
     data = {
         "num_queries": int(len(queries)),

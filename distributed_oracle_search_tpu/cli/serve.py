@@ -121,10 +121,33 @@ def build_frontend(conf: ClusterConfig, args):
     else:
         dispatcher = EngineDispatcher(conf, alg=args.alg,
                                       build_missing=args.test)
+    dc = dispatcher.dc if args.backend == "inproc" else _dc_for(conf)
+    # elastic membership: a committed epoch's owner table (and any
+    # in-flight migration's dual-read window) overrides the conf's
+    # static identity; absent membership.json = the pre-elastic world
+    from ..parallel import membership as fleet
+    mstate = fleet.load_state(conf.outdir)
+    if mstate is not None:
+        dc = fleet.apply_state(dc, mstate)
+        if args.backend == "inproc":
+            dispatcher.dc = dc
+    # the controller is wired even on a static fleet: its throttled
+    # refresh() picks up a membership.json that appears AFTER startup,
+    # so a long-lived serve observes later join/leave commits instead
+    # of routing to drained workers forever (epoch 0 keeps the wire and
+    # admission byte-identical — the epoch stamp is gated on nonzero)
+    mc = fleet.MembershipController(conf, dc)
+    if args.backend == "host":
+        # a joined worker's id is past the conf's static roster;
+        # resolve hosts (dispatch AND breaker keys) from the live
+        # membership roster instead
+        dispatcher.host_of = mc.host_of
+        breaker_key = lambda wid: (mc.host_of(wid), wid)  # noqa: E731
+    if mstate is not None:
+        log.info("serving under membership epoch %d", mc.epoch)
     frontend = ServingFrontend(
-        dispatcher.dc if args.backend == "inproc" else _dc_for(conf),
-        dispatcher, sconf=sconf, rconf=rconf, diff=diff,
-        registry=registry, breaker_key=breaker_key)
+        dc, dispatcher, sconf=sconf, rconf=rconf, diff=diff,
+        registry=registry, breaker_key=breaker_key, membership=mc)
     return frontend, registry
 
 

@@ -87,6 +87,10 @@ M_REPLICA_COPIED = obs_metrics.counter(
     "replica_blocks_copied_total",
     "replica blocks materialized by copying a digest-valid primary "
     "block instead of recomputing from the graph")
+M_BLOCKS_ADOPTED = obs_metrics.counter(
+    "reshard_blocks_adopted_total",
+    "blocks digest-verified (healing as needed) by a worker adopting "
+    "shard ownership during a membership reconfiguration")
 
 #: compressed device->host fm fetch below this raw size is not worth the
 #: extra device round trip (the count pass) — plain fetch instead
@@ -983,6 +987,51 @@ def anti_entropy(outdir: str, dc: DistributionController,
                     "from their primary (%d healed)",
                     len(report["mismatched"]), report["checked"],
                     len(report["healed"]))
+    return report
+
+
+def adopt_shard_blocks(graph: Graph, dc: DistributionController,
+                       shard: int, outdir: str) -> dict:
+    """Adopter catch-up for a membership ownership transfer
+    (``parallel.membership``): make shard ``shard``'s PRIMARY block set
+    servable on this filesystem — every block digest-verified against
+    the manifest, anything missing/torn healed through the shared
+    quarantine→copy→rebuild path (``heal_block``: a digest-valid
+    replica set is copied before any recompute). Idempotent and
+    crash-resumable for free: verification re-runs in O(read), and the
+    heal path journals rebuilt blocks through the build ledger exactly
+    like a normal build — a joining worker killed mid catch-up re-pays
+    only the blocks that never landed.
+
+    Returns ``{"shard", "blocks", "ok", "unverified", "healed": [...]}``;
+    raises when a block can neither be verified nor healed (the
+    migration must not commit over it)."""
+    try:
+        manifest = read_manifest(outdir)
+    except (OSError, ValueError):
+        manifest = None             # pre-manifest build: heal from graph
+    if manifest is not None:
+        check_manifest_version(manifest, outdir)
+    blocks_meta = (manifest or {}).get("blocks", {})
+    bs = dc.block_size
+    n_blocks = (dc.n_owned(int(shard)) + bs - 1) // bs
+    report: dict = {"shard": int(shard), "blocks": n_blocks, "ok": 0,
+                    "unverified": 0, "healed": []}
+    for bid in range(n_blocks):
+        fname = shard_block_name(int(shard), bid)
+        path = os.path.join(outdir, fname)
+        with obs_trace.span("reshard.adopt", file=fname, shard=shard):
+            status, reason = check_block(path, blocks_meta.get(fname))
+            if status == "ok":
+                report["ok"] += 1
+            elif status == "unverified":
+                report["unverified"] += 1
+            else:
+                M_BLOCKS_CORRUPT.inc()
+                heal_block(outdir, manifest, fname, int(shard), graph,
+                           dc, status=status, reason=reason)
+                report["healed"].append(fname)
+        M_BLOCKS_ADOPTED.inc()
     return report
 
 

@@ -151,6 +151,24 @@ dispatch, replica anti-entropy; README "Replication & failover"):
   ``replica_blocks_copied_total`` (replica blocks materialized by
   copying a digest-valid primary instead of recomputing).
 
+Elastic fleet membership (``parallel.membership`` — epoch-versioned
+shard→worker assignment, drain-free join/leave; README "Elastic
+fleet"):
+
+* epoch / reconfiguration — ``reshard_epoch`` (gauge: the committed
+  partition-table epoch; 0 = the static pre-elastic fleet),
+  ``reshard_migrations_total`` (windows begun),
+  ``reshard_shards_moved_total`` (ownership transfers committed),
+  ``reshard_aborted_total`` (windows closed without the bump),
+  ``reshard_catchup_seconds`` (per-shard adopter verify+heal);
+* catch-up data plane — ``reshard_blocks_adopted_total`` (blocks
+  digest-verified/healed by an adopting worker; the heal path itself
+  books the ``cpd_blocks_*`` series as usual);
+* version gate — ``server_stale_epoch_total`` (batches a worker
+  refused with the ``STALE_EPOCH`` wire sentinel: routed under a
+  NEWER table than the worker could see even after a membership
+  refresh).
+
 Live observability plane (this PR's standing layer — the scrape-time
 series every resident process exposes):
 

@@ -168,35 +168,71 @@ def fetch_statusz(endpoint: str, timeout_s: float = 3.0) -> dict:
 
 
 def _summarize(status: dict) -> dict:
-    """Flatten one endpoint's statusz into the fleet-table columns."""
+    """Flatten one endpoint's statusz into the fleet-table columns.
+
+    Schema-heterogeneous by design: a rolling upgrade mixes workers
+    that export the elastic-membership keys (``epoch``, ``migration``)
+    with workers that predate them — a missing or oddly-typed key
+    renders as a blank cell in that endpoint's row, never a crash of
+    the tool watching the upgrade."""
     if "error" in status:
         return {"state": "UNREACHABLE", "detail": status["error"]}
+
+    def _num(v, default=0):
+        # bool is an int subclass but not a count; null/str render as
+        # the default instead of raising out of a sum()
+        return (v if isinstance(v, (int, float))
+                and not isinstance(v, bool) else default)
+
     out: dict = {"state": "up"}
     serving = status.get("serving", {})
+    if not isinstance(serving, dict):
+        serving = {}
     if serving:
         shards = serving.get("shards", {})
-        out["queued"] = sum(s.get("queue_depth", 0)
-                            for s in shards.values())
-        out["shards"] = len(shards)
+        if isinstance(shards, dict):
+            out["queued"] = sum(_num(s.get("queue_depth"))
+                                for s in shards.values()
+                                if isinstance(s, dict))
+            out["shards"] = len(shards)
         hedge = serving.get("hedge", {})
-        if hedge:
-            out["hedge_rate"] = hedge.get("rate", 0.0)
+        if isinstance(hedge, dict) and hedge:
+            out["hedge_rate"] = _num(hedge.get("rate"), 0.0)
     # the serve frontend nests its breaker section under "serving";
     # a bare BreakerRegistry provider sits at the top level
-    breakers = (serving.get("breakers") or status.get("breakers")
-                or {}).get("breakers", {})
-    if breakers:
+    braw = serving.get("breakers") or status.get("breakers") or {}
+    breakers = (braw.get("breakers", {}) if isinstance(braw, dict)
+                else {})
+    if isinstance(breakers, dict) and breakers:
         out["breakers_open"] = sum(
             1 for b in breakers.values()
-            if b.get("state") in ("open", "half-open"))
+            if isinstance(b, dict)
+            and b.get("state") in ("open", "half-open"))
     worker = status.get("worker", {})
+    if not isinstance(worker, dict):
+        worker = {}
     if worker:
-        out["batches"] = worker.get("batches", 0)
-        out["failures"] = worker.get("batch_failures", 0)
+        out["batches"] = _num(worker.get("batches"))
+        out["failures"] = _num(worker.get("batch_failures"))
     sup = status.get("supervisor", {})
-    if sup:
-        out["alive"] = sup.get("alive", 0)
-        out["respawns"] = sup.get("respawns", 0)
+    if isinstance(sup, dict) and sup:
+        out["alive"] = _num(sup.get("alive"))
+        out["respawns"] = _num(sup.get("respawns"))
+    # elastic-membership columns: present only when the endpoint
+    # exports them (a pre-elastic worker's row shows "-" blanks)
+    for sec in (serving, worker):
+        if "epoch" in sec and isinstance(sec["epoch"], (int, float)):
+            out["epoch"] = int(sec["epoch"])
+            break
+    mig = serving.get("migration") or worker.get("migration")
+    if isinstance(mig, dict):
+        moves = mig.get("moves") if isinstance(mig.get("moves"), list) \
+            else []
+        done = mig.get("done") if isinstance(mig.get("done"), list) \
+            else []
+        out["migration"] = (f"{mig.get('kind', '?')}->e"
+                            f"{mig.get('epoch', '?')} "
+                            f"{len(done)}/{len(moves)}")
     return out
 
 

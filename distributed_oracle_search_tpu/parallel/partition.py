@@ -43,6 +43,23 @@ failover order the head walks when a primary is dead, and
 :meth:`DistributionController.replica_shards` is the set of shards a
 worker must hold rows for. ``replication=1`` is byte-for-byte today's
 behavior everywhere (placement, wire format, artifacts).
+
+Elastic membership (``epoch`` / ``owners``): the node→**shard** map
+above is fixed at build time (shard count = ``maxworker``), but the
+shard→**worker** assignment is versioned. ``owners[s]`` names the
+worker currently hosting shard ``s`` (identity by default — shard s on
+worker s, today's behavior byte-for-byte), and ``epoch`` is the
+monotonically increasing version of that assignment, bumped atomically
+by the reconfiguration controller (``parallel.membership``) whenever a
+worker joins or leaves. Every routing surface (``replica_workers``,
+``group_queries``'s dead-remap, the serving frontend's candidate sets)
+maps shard ids through ``owners``, so a committed epoch bump flips
+traffic without touching the partition quadruple or the on-disk block
+files. ``format_conf`` appends ``epoch``/``owner`` columns only for
+non-default assignments — legacy epoch-0 identity tables stay
+byte-identical on the wire, and ``parse_conf`` reads the columns by
+header name under the same unknown-column-tolerant compat contract as
+the ``rep<r>`` columns.
 """
 
 from __future__ import annotations
@@ -61,13 +78,14 @@ UNROUTABLE = -1
 class DistributionController:
     def __init__(self, partmethod: str, partkey, maxworker: int,
                  nodenum: int, block_size: int = DEFAULT_BLOCK_SIZE,
-                 replication: int = 1):
+                 replication: int = 1, epoch: int = 0, owners=None):
         self.partmethod = partmethod
         self.partkey = partkey
         self.maxworker = int(maxworker)
         self.nodenum = int(nodenum)
         self.block_size = int(block_size)
         self.replication = int(replication)
+        self.epoch = int(epoch)
         if self.maxworker <= 0:
             raise ValueError("maxworker must be positive")
         if not 1 <= self.replication <= self.maxworker:
@@ -75,6 +93,22 @@ class DistributionController:
                 f"replication {self.replication} not in [1, "
                 f"maxworker={self.maxworker}]: every replica rank must "
                 "land on a distinct worker")
+        if self.epoch < 0:
+            raise ValueError(f"epoch must be >= 0, got {self.epoch}")
+        if owners is None:
+            self.owners = np.arange(self.maxworker, dtype=np.int64)
+        else:
+            self.owners = np.asarray(owners, np.int64)
+            if self.owners.shape != (self.maxworker,):
+                raise ValueError(
+                    f"owners must name one worker per shard "
+                    f"(maxworker={self.maxworker}), got shape "
+                    f"{self.owners.shape}")
+            if self.nodenum and self.owners.min() < 0:
+                raise ValueError("owners must be non-negative worker ids")
+        #: identity assignment = the pre-elastic fleet, byte-for-byte
+        self._identity_owners = bool(
+            (self.owners == np.arange(self.maxworker)).all())
         self._wid = self._assign_all()
         # dense owned index per node: position within its owner's ascending
         # owned-node list. Vectorized: stable argsort by (wid, node).
@@ -132,30 +166,60 @@ class DistributionController:
         return int(self._counts.max()) if self.nodenum else 0
 
     # ---------------------------------------------------------- replicas
-    def replica_workers(self, wid: int) -> list[int]:
-        """Workers hosting shard ``wid``'s rows, in failover order:
-        rank 0 is the primary (``wid`` itself), rank r the worker
-        ``(wid + r) % maxworker``. Length == ``replication``."""
+    def owner_of(self, shard: int) -> int:
+        """The worker currently hosting shard ``shard``'s primary rows
+        (identity — shard s on worker s — unless a membership epoch
+        reassigned it)."""
+        return int(self.owners[int(shard)])
+
+    def _chain_shards(self, wid: int) -> list[int]:
+        """Shard ids in shard ``wid``'s replica chain, rank order."""
         return [(int(wid) + r) % self.maxworker
                 for r in range(self.replication)]
 
+    def replica_workers(self, wid: int) -> list[int]:
+        """Workers hosting shard ``wid``'s rows, in failover order:
+        rank 0 the shard's owner (worker ``wid`` itself under the
+        identity assignment), rank r the owner of chain slot
+        ``(wid + r) % maxworker``. Length == ``replication``."""
+        if self._identity_owners:
+            return self._chain_shards(wid)
+        return [self.owner_of(s) for s in self._chain_shards(wid)]
+
     def replica_shards(self, wid: int) -> list[int]:
-        """Shards worker ``wid`` hosts rows for: its own (rank 0) plus
-        the shard whose rank-r replica lands here, ``(wid - r) %
-        maxworker``. The inverse of :meth:`replica_workers`."""
-        return [(int(wid) - r) % self.maxworker
-                for r in range(self.replication)]
+        """Shards worker ``wid`` hosts rows for: the shard(s) it owns
+        (rank 0) plus the shard whose rank-r chain slot it owns. The
+        inverse of :meth:`replica_workers` (identity assignment: its
+        own shard plus ``(wid - r) % maxworker``)."""
+        if self._identity_owners and int(wid) < self.maxworker:
+            # the fast path only holds for in-range ids: a fresh
+            # joiner (wid >= maxworker) hosts nothing under identity —
+            # the modulo would wrongly claim another worker's shard
+            return [(int(wid) - r) % self.maxworker
+                    for r in range(self.replication)]
+        out = []
+        for shard in range(self.maxworker):
+            if int(wid) in self.replica_workers(shard):
+                out.append(shard)
+        return out
 
     def replica_rank(self, shard: int, host: int) -> int:
         """The replica rank with which worker ``host`` holds ``shard``'s
-        rows (0 = primary). Raises ``ValueError`` when ``host`` is not
-        in the shard's replica set."""
-        r = (int(host) - int(shard)) % self.maxworker
-        if r >= self.replication:
+        rows (0 = primary/owner). Raises ``ValueError`` when ``host`` is
+        not in the shard's replica set."""
+        if self._identity_owners and int(host) < self.maxworker:
+            r = (int(host) - int(shard)) % self.maxworker
+            if r >= self.replication:
+                raise ValueError(
+                    f"worker {host} holds no replica of shard {shard} "
+                    f"(replication={self.replication})")
+            return r
+        hosts = self.replica_workers(shard)
+        if int(host) not in hosts:
             raise ValueError(
                 f"worker {host} holds no replica of shard {shard} "
-                f"(replication={self.replication})")
-        return r
+                f"(hosts: {hosts})")
+        return hosts.index(int(host))
 
     def table(self) -> np.ndarray:
         """int64 [N, 4] rows of (node, wid, bid, bidx) — the
@@ -168,7 +232,7 @@ class DistributionController:
     def replica_table(self) -> np.ndarray:
         """int64 [N, replication-1]: column r-1 is the worker hosting
         replica rank r of each node. Empty (0 columns) at R=1."""
-        cols = [(self._wid + r) % self.maxworker
+        cols = [self.owners[(self._wid + r) % self.maxworker]
                 for r in range(1, self.replication)]
         if not cols:
             return np.zeros((self.nodenum, 0), np.int64)
@@ -178,18 +242,30 @@ class DistributionController:
         """The wire format the reference driver parses: one header line,
         then ``node,wid,bid,bidx`` per node (reference
         ``process_query.py:50-53``). With replication, ``rep<r>`` columns
-        (the rank-r replica's worker) append on the right — same compat
-        contract as the wire codecs: readers take columns by header name
-        and tolerate unknown ones, so an R=1 consumer reading the first
-        four columns of an R>1 table still routes correctly, and R=1
-        output is byte-identical to the legacy format."""
+        (the rank-r replica's worker) append on the right; an elastic
+        table (``epoch > 0`` or a non-identity assignment) additionally
+        appends ``epoch`` (the table's version, constant per row) and
+        ``owner`` (the worker hosting the node's shard) columns — same
+        compat contract as the wire codecs: readers take columns by
+        header name and tolerate unknown ones, so an R=1 consumer
+        reading the first four columns of an elastic table still routes
+        on the primary shard, and epoch-0 identity R=1 output is
+        byte-identical to the legacy format."""
         rows = self.table()
         rep = self.replica_table()
+        elastic = self.epoch > 0 or not self._identity_owners
         header = "node,wid,bid,bidx" + "".join(
             f",rep{r}" for r in range(1, self.replication))
+        if elastic:
+            header += ",epoch,owner"
         lines = [header]
-        lines += [",".join(map(str, [*row, *reps]))
-                  for row, reps in zip(rows, rep)]
+        if elastic:
+            own = self.owners[self._wid]
+            lines += [",".join(map(str, [*row, *reps, self.epoch, o]))
+                      for row, reps, o in zip(rows, rep, own)]
+        else:
+            lines += [",".join(map(str, [*row, *reps]))
+                      for row, reps in zip(rows, rep)]
         return "\n".join(lines)
 
     # ------------------------------------------------------------ routing
@@ -225,15 +301,16 @@ class DistributionController:
                      if h not in dead), UNROUTABLE)
             wids = remap[wids]
         groups = {}
-        wid_range = ([UNROUTABLE] if dead else []) + list(
-            range(self.maxworker))
-        for wid in wid_range:
+        # bucket over the ids actually PRESENT (ascending, UNROUTABLE
+        # first — np.unique sorts, so iteration order matches the old
+        # range(maxworker) walk exactly): a dead-remap through an
+        # elastic owner table can name a joined worker past maxworker,
+        # and a fixed range would silently drop its queries
+        for wid in (int(w) for w in np.unique(wids)):
             if active_worker != -1 and wid != active_worker \
                     and wid != UNROUTABLE:
                 continue
-            mask = wids == wid
-            if mask.any():
-                groups[wid] = queries[mask]
+            groups[wid] = queries[wids == wid]
         return groups
 
 
@@ -245,10 +322,13 @@ def parse_conf(text: str) -> dict:
     (the wire codecs' compat contract): a legacy R=1 table (no ``rep*``
     columns) parses with ``replication == 1``, an R>1 table parsed by
     old code that only reads the first four columns still routes on the
-    primary, and future columns cannot break this parser.
+    primary, and future columns cannot break this parser. Elastic
+    tables add ``epoch`` (constant table version; an epoch-less legacy
+    conf parses as epoch 0) and ``owner`` (the worker hosting each
+    node's shard; absent = the shard id itself) columns.
 
-    Returns ``{"node", "wid", "bid", "bidx": int64 [N];
-    "replicas": int64 [N, R-1]; "replication": R}``.
+    Returns ``{"node", "wid", "bid", "bidx", "owner": int64 [N];
+    "replicas": int64 [N, R-1]; "replication": R; "epoch": int}``.
     """
     lines = [ln for ln in text.strip().split("\n") if ln.strip()]
     if not lines:
@@ -281,4 +361,15 @@ def parse_conf(text: str) -> dict:
                        if rep_cols
                        else np.zeros((len(rows), 0), np.int64))
     out["replication"] = len(rep_cols) + 1
+    if "epoch" in idx:
+        epochs = np.unique(rows[:, idx["epoch"]])
+        if len(epochs) > 1:
+            raise ValueError(
+                f"distribute conf mixes epochs {epochs.tolist()} — a "
+                "table is one atomic assignment version")
+        out["epoch"] = int(epochs[0]) if len(epochs) else 0
+    else:
+        out["epoch"] = 0          # legacy epoch-less conf
+    out["owner"] = (rows[:, idx["owner"]] if "owner" in idx
+                    else out["wid"].copy())
     return out

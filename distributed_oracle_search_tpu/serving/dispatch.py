@@ -94,17 +94,31 @@ class EngineDispatcher:
         build_worker_shard(self.graph, self.dc, shard, self.conf.outdir,
                            chunk=self.build_chunk, replica=replica)
 
+    def _rank_for(self, wid: int, via: int) -> int:
+        """Which block set lane ``(wid, via)`` serves from: the via
+        worker's rank in the shard's replica chain — or the PRIMARY set
+        when ``via`` is outside the chain (a membership-migration
+        adopter answering dual-read traffic before its epoch commits,
+        or after a commit reassigned ownership off-chain)."""
+        if via == wid:
+            return 0
+        try:
+            return self.dc.replica_rank(wid, via)
+        except ValueError:
+            return 0
+
     def _engine_for(self, wid: int, via: int | None = None):
         from ..worker.engine import ShardEngine
 
         via = wid if via is None else int(via)
+        rank = self._rank_for(wid, via)
         with self._lock:
             eng = self._engines.get((wid, via))
             if eng is None:
                 try:
                     eng = ShardEngine(self.graph, self.dc, via,
                                       self.conf.outdir, alg=self.alg,
-                                      shard=wid)
+                                      shard=wid, replica=rank)
                 except (FileNotFoundError, ValueError):
                     # ValueError covers a PARTIAL block set (a killed
                     # lazy build left some blocks; the row count fails
@@ -114,11 +128,10 @@ class EngineDispatcher:
                     # and the retry's raise propagates it.
                     if not self.build_missing:
                         raise
-                    self._build_missing_shard(
-                        wid, self.dc.replica_rank(wid, via))
+                    self._build_missing_shard(wid, rank)
                     eng = ShardEngine(self.graph, self.dc, via,
                                       self.conf.outdir, alg=self.alg,
-                                      shard=wid)
+                                      shard=wid, replica=rank)
                 self._engines[(wid, via)] = eng
             return eng
 
@@ -145,11 +158,19 @@ class FifoDispatcher:
 
     def __init__(self, conf: ClusterConfig,
                  timeout: float | None = None,
-                 policy: fifo_transport.RetryPolicy | None = None):
+                 policy: fifo_transport.RetryPolicy | None = None,
+                 host_of=None):
         self.conf = conf
         self.timeout = (timeout if timeout is not None
                         else fifo_transport.DEFAULT_TIMEOUT)
         self.policy = policy
+        #: worker id -> ssh host. The default reads the conf's static
+        #: roster (wrapping for ids past it — an elastic JOIN mints
+        #: worker ids the conf never listed); a membership-aware caller
+        #: passes the live roster resolver
+        #: (``MembershipController.host_of``) instead.
+        self.host_of = host_of or (
+            lambda via: self.conf.workers[via % len(self.conf.workers)])
         self._seq = itertools.count()
         #: per dispatch lane ((shard, via) pair): the previous batch's
         #: query file and answer-FIFO base, swept on the lane's next
@@ -219,7 +240,7 @@ class FifoDispatcher:
                      rconf: RuntimeConfig, diff: str,
                      via: int | None = None):
         via = wid if via is None else int(via)
-        host = self.conf.workers[via]
+        host = self.host_of(via)
         nfs = self.conf.nfs
         lane = (wid, via)
         with self._lane_lock(lane):

@@ -81,9 +81,18 @@ class ServingFrontend:
                  sconf: ServeConfig | None = None,
                  rconf: RuntimeConfig | None = None,
                  diff: str = "-", registry=None, breaker_key=None,
-                 hconf: HedgeConfig | None = None):
+                 hconf: HedgeConfig | None = None, membership=None):
         self.dc = dc
         self.dispatcher = dispatcher
+        #: elastic-membership hook (``parallel.membership
+        #: .MembershipController`` or anything with ``epoch``,
+        #: ``candidates_for(shard)`` and ``statusz()``): when set, each
+        #: batch's candidate chain comes from the LIVE assignment —
+        #: during a migration window that is the dual-read order (old
+        #: owner authoritative, adopter second) — and the committed
+        #: epoch is stamped on the wire. None = the controller's static
+        #: chain, byte-for-byte the pre-elastic behavior.
+        self.membership = membership
         self.sconf = sconf or ServeConfig.from_env()
         self.rconf = rconf or RuntimeConfig()
         self.diff = diff
@@ -170,19 +179,27 @@ class ServingFrontend:
         wid = int(self.dc.worker_of(t))   # scalar index, no per-request
         # array allocation on the admission hot path
         if self.registry is not None:
-            if self.dc.replication == 1:
-                # unreplicated: the pre-replication admission path,
-                # byte for byte (allow() keeps its trial semantics)
-                if not self.registry.allow(self._breaker_key(wid)):
+            cands = self._candidates(wid)
+            if len(cands) == 1:
+                # single candidate: the pre-replication admission path,
+                # byte for byte (allow() keeps its trial semantics);
+                # the breaker belongs to the shard's LIVE owner — the
+                # shard id itself until a membership epoch moves it
+                # (self._candidates reads the live view, so an epoch
+                # committed mid-serve re-keys admission too)
+                if not self.registry.allow(
+                        self._breaker_key(cands[0])):
                     M_UNAVAIL.inc()
                     return self._immediate(ServeResult(
                         UNAVAILABLE, s, t, detail="circuit-open"), now)
             elif not any(
                     self.registry.available(self._breaker_key(c))
-                    for c in self.dc.replica_workers(wid)):
-                # every replica of the target shard is breaker-dead:
-                # shed NOW — queueing would only turn a fast explicit
-                # answer into a deadline'd hang
+                    for c in cands):
+                # every candidate (replica chain, plus the adopter when
+                # a dual-read window is open — >1 candidates can happen
+                # even at R=1) is breaker-dead: shed NOW — queueing
+                # would only turn a fast explicit answer into a
+                # deadline'd hang
                 M_UNAVAIL.inc()
                 return self._immediate(ServeResult(
                     UNAVAILABLE, s, t, detail="no-live-replica"), now)
@@ -222,8 +239,11 @@ class ServingFrontend:
                 "queue_depth": len(q),
                 "queue_bound": q.depth,
                 "closed": q.closed,
-                "replicas": [int(c)
-                             for c in self.dc.replica_workers(wid)],
+                # the LIVE candidate chain dispatch actually walks
+                # (dual-read order during a migration window) — the
+                # static construction-time chain would name the wrong
+                # workers during exactly the incidents this page is for
+                "replicas": [int(c) for c in self._candidates(wid)],
                 "hedge_delay_ms": round(
                     self.hedge.delay_s(wid) * 1e3, 3),
             }
@@ -231,6 +251,9 @@ class ServingFrontend:
             "serving": self._started and not self._closed,
             "diff": self.diff,
             "replication": int(self.dc.replication),
+            "epoch": int(self.membership.epoch
+                         if self.membership is not None
+                         else self.dc.epoch),
             "shards": shards,
             "hedge": {
                 "enabled": self.hedge.config.enabled,
@@ -242,6 +265,10 @@ class ServingFrontend:
                 "max_bytes": self.cache.max_bytes,
             },
         }
+        if self.membership is not None:
+            mstat = self.membership.statusz()
+            if "migration" in mstat:
+                out["migration"] = mstat["migration"]
         if self.registry is not None:
             out["breakers"] = self.registry.statusz()
         return out
@@ -256,6 +283,15 @@ class ServingFrontend:
             log.info("diff change %s -> %s: %d cache entries dropped",
                      self.diff, diff, n)
             self.diff = diff
+
+    def _candidates(self, wid: int) -> list[int]:
+        """The shard's candidate chain from the LIVE assignment when a
+        membership hook is wired (dual-read windows, epoch commits made
+        by other processes), else the controller's static chain —
+        byte-for-byte the pre-elastic behavior."""
+        if self.membership is not None:
+            return self.membership.candidates_for(wid)
+        return self.dc.replica_workers(wid)
 
     # --------------------------------------------------------- completion
     def _immediate(self, res: ServeResult, t_submit: float) -> Future:
@@ -325,7 +361,7 @@ class ServingFrontend:
         err = ""
         ok = False
         cost = plen = fin = None
-        candidates = self.dc.replica_workers(wid)
+        candidates = self._candidates(wid)
         attempted = False
         failed_over = False
         for via in candidates:
@@ -335,7 +371,7 @@ class ServingFrontend:
                 # dead replica: skip without a dispatch (R=1 keeps the
                 # admission-time breaker semantics — no second gate)
                 continue
-            if attempted or via != wid:
+            if attempted or via != candidates[0]:
                 if not failed_over:
                     failed_over = True
                     resilience.M_FAILOVER.inc()
@@ -387,6 +423,12 @@ class ServingFrontend:
         otherwise be untagged), rides the wire so a FIFO worker captures
         its spans under it, and labels the dispatch span."""
         rconf = self.rconf
+        epoch = (self.membership.epoch if self.membership is not None
+                 else self.dc.epoch)
+        if epoch and not rconf.epoch:
+            # the wire carries the table version the routing decision
+            # was made under (elastic-membership wire extension)
+            rconf = dataclasses.replace(rconf, epoch=epoch)
         if tid:
             obs_trace.set_trace_id(tid)
             if not rconf.trace_id:

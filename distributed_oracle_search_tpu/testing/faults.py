@@ -26,6 +26,18 @@ Points (enacted by the call sites, see the table in the README's
                      next block starts (``mode=exit`` → ``os._exit(86)``
                      default; ``mode=raise`` → RuntimeError). The
                      kill-mid-build resume test's trigger.
+* ``kill-during-reshard``  the membership reconfiguration controller
+                     dies between shard catch-up moves — after a move's
+                     journal line landed, before the next shard starts
+                     (``mode=exit`` / ``mode=raise`` like
+                     ``crash-build``). The reshard crash-resume
+                     trigger: the dual-read window stays open, the
+                     journal resumes the tail.
+* ``stale-epoch-reply``  the worker refuses the batch with the
+                     ``STALE_EPOCH`` wire sentinel even though its
+                     table may be current — the analog of a worker
+                     whose membership state is wedged behind the
+                     fleet, forcing the head's failover path.
 
 Rule keys: ``wid`` restricts to one worker id, ``after`` skips the first
 N eligible events, ``times`` caps fires (``inf`` = always), ``delay`` and
@@ -56,7 +68,8 @@ log = get_logger(__name__)
 KILL_EXIT_CODE = 86
 
 POINTS = ("drop-reply", "delay", "crash-engine", "corrupt-frame",
-          "kill-mid-batch", "crash-build")
+          "kill-mid-batch", "crash-build", "kill-during-reshard",
+          "stale-epoch-reply")
 
 M_INJECTED = obs_metrics.counter(
     "faults_injected_total", "fault-harness rules fired (DOS_FAULTS)")

@@ -42,6 +42,17 @@ HEAD_STAT_FIELDS = ("t_prepare", "t_partition", "size")
 #: 10-field CSV line and can never equal this)
 FAIL_LINE = "FAIL"
 
+#: answer-FIFO sentinel for the fleet-membership version gate: a worker
+#: whose partition-table epoch is OLDER than the request's
+#: ``RuntimeConfig.epoch`` (and that stayed older after refreshing its
+#: membership state) refuses the batch with this line instead of
+#: serving rows it may no longer own. New heads read it as a failed row
+#: with ``stale_epoch`` set (failover walks on to the next candidate);
+#: old heads see an undecodable line and book the same failed row —
+#: the sentinel only ever appears when a NEW head stamped a nonzero
+#: epoch, so legacy deployments never meet it.
+STALE_EPOCH_LINE = "STALE_EPOCH"
+
 #: liveness control frame: ``__DOS_PING__ <answerfifo>`` as a single
 #: command-FIFO line asks the server to write one health JSON line
 #: (:class:`HealthStatus`) to the named FIFO — the wire half of
@@ -73,6 +84,17 @@ class RuntimeConfig:
     compat contract as ``extract``: old peers filter the unknown key,
     and ``""`` (the default) disables capture.
 
+    ``epoch`` is the elastic-membership wire extension
+    (``parallel.membership``): the head stamps the partition-table
+    epoch its routing decisions were made under. A worker at a NEWER
+    epoch serves the batch anyway (older routing is a superset the
+    worker can still answer during the migration window); a worker at
+    an OLDER epoch refreshes its membership state and, if still older,
+    refuses with the ``STALE_EPOCH`` sentinel so the head fails over —
+    the version-gate contract of the other codecs (tolerate older,
+    gate only on newer) applied to routing state. ``0`` (the default)
+    is the pre-elastic world and never gates.
+
     ``results`` is the online-serving wire extension (``serving``): the
     reference's campaign wire only ever returns aggregate batch stats —
     per-query costs stay on the workers. A serving frontend needs them
@@ -95,6 +117,7 @@ class RuntimeConfig:
     extract: bool = False
     trace_id: str = ""
     results: bool = False
+    epoch: int = 0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -143,6 +166,10 @@ class StatsRow:
     t_astar: float = 0.0
     t_search: float = 0.0
     ok: bool = True          # head-side: False marks a failed worker batch
+    #: head-side: the worker refused the batch because its partition
+    #: table is OLDER than the request's epoch (the ``STALE_EPOCH``
+    #: wire sentinel) — a routing-state failure, not an engine one
+    stale_epoch: bool = False
 
     def encode(self) -> str:
         vals = [getattr(self, f) for f in ENGINE_STAT_FIELDS]
@@ -153,6 +180,11 @@ class StatsRow:
     def decode(cls, line: str) -> "StatsRow":
         if line.strip() == FAIL_LINE:
             return cls.failed()
+        if line.strip().startswith(STALE_EPOCH_LINE):
+            # "STALE_EPOCH [<worker epoch>]": a failed row flagged so
+            # the head can tell a routing-state refusal from an engine
+            # crash (failover treats both the same; operators do not)
+            return cls(ok=False, stale_epoch=True)
         parts = line.strip().split(",")
         if len(parts) != len(ENGINE_STAT_FIELDS):
             raise ValueError(
@@ -173,7 +205,10 @@ class StatsRow:
     def encode_wire(self) -> str:
         """Wire line including the failure marker: failed rows encode as the
         ``FAIL`` sentinel so the head can tell them from an all-zero batch
-        (success rows keep the reference's 10-field CSV exactly)."""
+        (success rows keep the reference's 10-field CSV exactly;
+        stale-epoch refusals carry their own sentinel)."""
+        if self.stale_epoch:
+            return STALE_EPOCH_LINE
         return FAIL_LINE if not self.ok else self.encode()
 
     def as_list(self, t_prepare: float = 0.0, t_partition: float = 0.0,

@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rebuild every block from scratch (default: "
                         "resume — skip blocks the build ledger records "
                         "as complete with a matching on-disk digest)")
+    p.add_argument("--adopt-shard", type=int, default=None,
+                   metavar="SHARD",
+                   help="membership catch-up mode: instead of building "
+                        "this worker's own rows, digest-verify (and "
+                        "heal via the copy/rebuild path) the named "
+                        "shard's primary block set — what a joining "
+                        "worker runs before the reconfiguration "
+                        "controller commits the epoch bump. Idempotent "
+                        "and crash-resumable (build-ledger journaled)")
     p.add_argument("--replication", type=int, default=None,
                    help="R-way shard replication: after the primary "
                         "rows, also build this worker's hosted replica "
@@ -100,6 +109,22 @@ def main(argv=None) -> int:
     dc = DistributionController(args.partmethod, partkey, args.maxworker,
                                 graph.n, replication=replication,
                                 **dc_kw)
+    if args.adopt_shard is not None:
+        from ..models.cpd import adopt_shard_blocks
+
+        report = adopt_shard_blocks(graph, dc, args.adopt_shard, outdir)
+        log.info("worker %d: adopted shard %d (%d block(s): %d ok, "
+                 "%d unverified, %d healed)", args.workerid,
+                 args.adopt_shard, report["blocks"], report["ok"],
+                 report["unverified"], len(report["healed"]))
+        print(f"worker {args.workerid}: adopted shard "
+              f"{args.adopt_shard} ({report['blocks']} block(s), "
+              f"{len(report['healed'])} healed) -> {outdir}")
+        if args.metrics_dump:
+            from ..obs import metrics as obs_metrics
+
+            obs_metrics.REGISTRY.dump_json(args.metrics_dump)
+        return 0
     written = build_worker_shard(graph, dc, args.workerid, outdir,
                                  chunk=args.chunk,
                                  resume=not args.no_resume,

@@ -145,7 +145,7 @@ def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
 class ShardEngine:
     def __init__(self, graph: Graph, dc: DistributionController, wid: int,
                  outdir: str, alg: str = "table-search",
-                 shard: int | None = None):
+                 shard: int | None = None, replica: int | None = None):
         import jax.numpy as jnp
         from ..ops import DeviceGraph
 
@@ -160,8 +160,15 @@ class ShardEngine:
         #: replica (failover/hedge target). The rows load from the
         #: matching replica block set.
         self.shard = wid if shard is None else int(shard)
-        self.replica = (dc.replica_rank(self.shard, wid)
-                        if self.shard != wid else 0)
+        #: which block set serves the rows: the rank within the shard's
+        #: replica chain, derived from the controller unless the caller
+        #: pins it (a membership-migration adopter serves the PRIMARY
+        #: set of a shard whose chain it has not joined yet)
+        if replica is not None:
+            self.replica = int(replica)
+        else:
+            self.replica = (dc.replica_rank(self.shard, wid)
+                            if self.shard != wid else 0)
         #: device-batch rows per A* chunk; the deadline is checked
         #: between chunks (first chunk always runs)
         self.astar_chunk = 1024

@@ -76,12 +76,19 @@ M_REPLICA_BATCHES = obs_metrics.counter(
     "server_replica_batches_total",
     "batches answered from a hosted REPLICA shard (failover/hedge "
     "traffic re-routed off the shard's primary)")
+M_STALE_EPOCH = obs_metrics.counter(
+    "server_stale_epoch_total",
+    "batches refused with STALE_EPOCH: the request was routed under a "
+    "NEWER partition-table epoch than this worker has, even after a "
+    "membership refresh")
 
 
 class FifoServer:
     def __init__(self, conf: ClusterConfig, wid: int,
                  command_fifo: str | None = None,
                  alg: str = "table-search"):
+        from ..parallel import membership
+
         self.conf = conf
         self.wid = wid
         self.alg = alg
@@ -90,36 +97,79 @@ class FifoServer:
         self.dc = DistributionController(
             conf.partmethod, conf.partkey, conf.maxworker, self.graph.n,
             replication=conf.effective_replication())
-        self.engine = ShardEngine(self.graph, self.dc, wid, conf.outdir,
-                                  alg=alg)
+        # elastic membership: the durable assignment (epoch + shard
+        # owners) next to the index overrides the conf's static
+        # identity — absent for a pre-elastic fleet (epoch 0)
+        self._membership_state = membership.load_state(conf.outdir)
+        if self._membership_state is not None:
+            self.dc = membership.apply_state(self.dc,
+                                             self._membership_state)
+        self.epoch = self.dc.epoch
         #: lazily-loaded engines for the REPLICA shards this worker
         #: hosts (rank 1..R-1): failover traffic pays the replica load
         #: on first use, never at startup
-        self._replica_engines: dict[int, ShardEngine] = {
-            wid: self.engine}
-        # preload the first diff's weights like the reference server does
-        # (make_fifos.py:18 loads only diffs[0])
-        if conf.diffs:
-            self.engine._weights_for(conf.diffs[0], no_cache=False)
+        self._replica_engines: dict[int, ShardEngine] = {}
+        # the eager primary engine serves the first shard this worker
+        # OWNS (identity assignment: its own wid — today's behavior).
+        # A fresh joiner owns nothing until its first epoch commits; it
+        # starts engine-less and loads adopted shards lazily through
+        # engine_for_shard, so join really is drain-free
+        own = next((s for s in range(self.dc.maxworker)
+                    if self.dc.owner_of(s) == wid), None)
+        self.engine: ShardEngine | None = None
+        if own is not None:
+            self.engine = ShardEngine(self.graph, self.dc, wid,
+                                      conf.outdir, alg=alg, shard=own)
+            self._replica_engines[own] = self.engine
+            # preload the first diff's weights like the reference
+            # server does (make_fifos.py:18 loads only diffs[0])
+            if conf.diffs:
+                self.engine._weights_for(conf.diffs[0], no_cache=False)
+        else:
+            log.info("worker %d owns no shard at epoch %d (fresh "
+                     "joiner); engines load lazily on adoption "
+                     "traffic", wid, self.epoch)
 
     def engine_for_shard(self, shard: int) -> ShardEngine:
         """The engine serving ``shard``'s rows — the primary engine for
-        our own shard, a lazily-created replica engine for shards whose
-        replica this worker hosts, and a routing-invariant error for
+        an owned shard, a lazily-created replica engine for shards whose
+        replica this worker hosts (or that it is mid-ADOPTING during a
+        membership migration window), and a routing-invariant error for
         anything else (the engine's own check would catch it, but this
         diagnostic names the replica map)."""
+        from ..parallel import membership
+
         eng = self._replica_engines.get(shard)
         if eng is None:
-            if shard not in self.dc.replica_shards(self.wid):
+            def _hosted():
+                return membership.hosted_shards(
+                    getattr(self, "_membership_state", None), self.dc,
+                    self.wid)
+
+            hosted = _hosted()
+            if shard not in hosted:
+                # before refusing, re-read membership: a migration
+                # WINDOW opens without an epoch bump, so a worker
+                # started before `begin` only learns it is the adopter
+                # when dual-read traffic actually lands here
+                self._refresh_membership()
+                hosted = _hosted()
+            if shard not in hosted:
                 raise ValueError(
                     f"worker {self.wid} hosts no replica of shard "
-                    f"{shard} (hosted: {self.dc.replica_shards(self.wid)})"
+                    f"{shard} (hosted: {sorted(hosted)})"
                     " — routing invariant violated")
-            log.info("worker %d: loading replica of shard %d for "
-                     "failover traffic", self.wid, shard)
+            log.info("worker %d: loading shard %d for failover/"
+                     "adoption traffic", self.wid, shard)
+            try:
+                rank = self.dc.replica_rank(shard, self.wid)
+            except ValueError:
+                # mid-adoption: not in the shard's replica chain yet —
+                # serve the primary block set the catch-up verified
+                rank = 0
             eng = ShardEngine(self.graph, self.dc, self.wid,
                               self.conf.outdir, alg=self.alg,
-                              shard=shard)
+                              shard=shard, replica=rank)
             self._replica_engines[shard] = eng
         return eng
 
@@ -151,16 +201,44 @@ class FifoServer:
                             queryfile=req.queryfile):
             queries = read_query_file(req.queryfile)
         engine = self.engine
-        if self.dc.replication > 1 and len(queries):
-            # replica-aware dispatch: a failover/hedge batch targets a
-            # shard we host as a replica — serve it from that replica's
-            # engine instead of failing the primary's routing
-            # invariant. (R=1 skips the ownership scan: the engine's
-            # own routing check covers misroutes.)
+        if len(queries):
+            # shard-aware dispatch: a failover/hedge batch targets a
+            # shard we host as a replica — or one we own/are adopting
+            # under an elastic membership assignment — serve it from
+            # that shard's engine instead of failing the primary's
+            # routing invariant. The scan runs unconditionally (one
+            # np.unique over the batch targets): it is also how a
+            # worker started BEFORE a migration window discovers it is
+            # the adopter (engine_for_shard refreshes membership on a
+            # hosted miss), and a genuine misroute still fails with
+            # the routing-invariant diagnostic, now naming the full
+            # hosted-shard map.
             shards = np.unique(self.dc.worker_of(queries[:, 1]))
-            if len(shards) == 1 and int(shards[0]) != self.wid:
+            if len(shards) == 1 and (engine is None
+                                     or int(shards[0]) != engine.shard):
                 engine = self.engine_for_shard(int(shards[0]))
-                M_REPLICA_BATCHES.inc()
+                if (engine is not self.engine
+                        and int(self.dc.owner_of(int(shards[0])))
+                        != self.wid):
+                    # count only genuinely re-routed traffic: after a
+                    # leave consolidates two OWNED shards onto this
+                    # worker, the non-eager one's batches are
+                    # authoritative, not failover
+                    M_REPLICA_BATCHES.inc()
+        if engine is None:
+            if len(queries):
+                # a fresh joiner got a batch it has no engine for (the
+                # single-shard case resolved above would have raised or
+                # loaded one; this is a multi-shard misroute): FAIL it
+                # loudly so failover walks on — an ok=True zero row
+                # would silently swallow the queries
+                raise ValueError(
+                    f"worker {self.wid} owns no shard and the batch "
+                    f"spans shards "
+                    f"{np.unique(self.dc.worker_of(queries[:, 1])).tolist()}"
+                    " — routing invariant violated")
+            # an empty batch needs no engine: answer the empty row
+            return StatsRow()
         cost, plen, fin, stats = engine.answer(queries, req.config,
                                                req.difffile)
         if engine.last_paths is not None:
@@ -258,6 +336,15 @@ class FifoServer:
                     log.error("bad request: %s", e)
                     M_MALFORMED.inc()
                     self._answer_malformed(text)
+                    continue
+                stale = self._epoch_gate(req.config)
+                if stale is not None:
+                    # version-gated refusal: the head routed this batch
+                    # under a NEWER partition table than we can see —
+                    # answer the sentinel so failover walks on instead
+                    # of us serving rows we may no longer own
+                    self._reply(req.answerfifo,
+                                stale.encode_wire() + "\n")
                     continue
                 kill = faults.inject("kill-mid-batch", wid=self.wid)
                 if kill is not None:
@@ -438,6 +525,66 @@ class FifoServer:
         """Write the stop token into our own FIFO (for another process)."""
         stop_server(self.command_fifo)
 
+    # -------------------------------------------------- membership gate
+    def _epoch_gate(self, config) -> StatsRow | None:
+        """The wire-compat version gate applied to routing state: a
+        request stamped with a NEWER partition-table epoch than ours
+        first triggers a membership refresh (the commit may simply not
+        have been read yet — the normal case right after an epoch
+        bump), and only if we are STILL older is it refused with the
+        ``STALE_EPOCH`` sentinel. Requests from older epochs are always
+        served (the dual-read window depends on it). Returns the
+        refusal row, or None to proceed."""
+        if faults.inject("stale-epoch-reply", wid=self.wid) is not None:
+            # the injected analog of a worker whose membership state
+            # is wedged behind the fleet: refuse even though our table
+            # may be current, forcing the head's failover path
+            log.error("fault: worker %d replying STALE_EPOCH", self.wid)
+            M_STALE_EPOCH.inc()
+            return StatsRow(ok=False, stale_epoch=True)
+        req_epoch = int(getattr(config, "epoch", 0) or 0)
+        if req_epoch <= getattr(self, "epoch", 0):
+            return None
+        self._refresh_membership()
+        if req_epoch <= getattr(self, "epoch", 0):
+            return None
+        M_STALE_EPOCH.inc()
+        log.warning("worker %d at epoch %d refusing batch from epoch "
+                    "%d (membership state has no newer commit)",
+                    self.wid, getattr(self, "epoch", 0), req_epoch)
+        return StatsRow(ok=False, stale_epoch=True)
+
+    def _refresh_membership(self) -> None:
+        """Re-read the durable membership state (epoch + owners +
+        in-flight migration) and swap in a controller reflecting it.
+        A same-epoch state still applies when its CONTENT changed —
+        `begin` opens a migration window without bumping the epoch,
+        and the adopter must see the window to host dual-read traffic.
+        An older epoch never applies (a lagging reader must not roll
+        routing back). Loaded engines keep serving — the node→shard
+        map never changes, only ownership."""
+        from ..parallel import membership
+
+        if not hasattr(self, "conf"):       # bare test server
+            return
+        try:
+            state = membership.load_state(self.conf.outdir)
+        except ValueError as e:
+            log.error("membership refresh failed: %s", e)
+            return
+        if state is None or state.epoch < getattr(self, "epoch", 0):
+            return
+        cur = getattr(self, "_membership_state", None)
+        if cur is not None and state.to_dict() == cur.to_dict():
+            return
+        self._membership_state = state
+        self.dc = membership.apply_state(self.dc, state)
+        self.epoch = state.epoch
+        log.info("worker %d refreshed membership (epoch %d%s)",
+                 self.wid, self.epoch,
+                 ", migration window open"
+                 if state.migration is not None else "")
+
     # ----------------------------------------------------- obs endpoints
     def _health_status(self) -> HealthStatus:
         """One health truth for both probes: the ``__DOS_PING__``
@@ -477,6 +624,14 @@ class FifoServer:
         if self.dc.replication > 1:
             out["replica_shards_hosted"] = sorted(
                 int(s) for s in self.dc.replica_shards(self.wid))
+        # elastic membership: which table version this worker serves
+        # under, and (when a reconfiguration is in flight) the window —
+        # a pre-elastic worker simply omits both keys, and consumers
+        # (`dos-obs top`) render blanks for a missing key, never crash
+        out["epoch"] = int(getattr(self, "epoch", 0))
+        state = getattr(self, "_membership_state", None)
+        if state is not None and state.migration is not None:
+            out["migration"] = dict(state.migration)
         try:
             out["build_ledger_blocks"] = len(
                 BuildLedger(self.conf.outdir, self.wid).entries())

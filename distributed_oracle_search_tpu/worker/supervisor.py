@@ -207,11 +207,12 @@ class WorkerSupervisor:
         if self._thread is not None:
             self._thread.join(timeout=join_s)
             self._thread = None
-        for w in self.workers.values():
+        workers = self._snapshot()
+        for w in workers:
             if w.proc is None or w.proc.poll() is not None:
                 continue
             stop_server(w.fifo, deadline_s=1.0)
-        for w in self.workers.values():
+        for w in workers:
             if w.proc is None:
                 continue
             try:
@@ -232,21 +233,114 @@ class WorkerSupervisor:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # ------------------------------------------------ elastic membership
+    def _snapshot(self) -> list[SupervisedWorker]:
+        """Consistent view of the supervised set: join/leave mutate the
+        dict from other threads while the monitor iterates."""
+        with self._lock:
+            return list(self.workers.values())
+
+    def add_worker(self, wid: int, fifo: str | None = None,
+                   wait_ready_s: float = 120.0) -> SupervisedWorker:
+        """Drain-free JOIN support: spawn and supervise one more worker
+        without touching the running fleet. Readiness is confirmed by a
+        liveness ping (same rule as :meth:`start`); the reconfiguration
+        controller flips routing only after the adopter is serving."""
+        with self._lock:
+            if wid in self.workers:
+                raise ValueError(f"worker {wid} is already supervised")
+        w = SupervisedWorker(wid, fifo or self._fifo_for(wid))
+        # spawn BEFORE publishing: the monitor thread iterates the
+        # supervised set concurrently, and an entry with proc=None
+        # would read as a dead worker — scheduling a respawn that races
+        # this spawn for the same command FIFO
+        w.proc = self.spawn_fn(w)
+        with self._lock:
+            if wid in self.workers:
+                w.proc.terminate()
+                raise ValueError(f"worker {wid} is already supervised")
+            self.workers[wid] = w
+        log.info("supervisor: joined worker %d (pid %d)", wid,
+                 w.proc.pid)
+        deadline = time.monotonic() + wait_ready_s
+        try:
+            while time.monotonic() < deadline:
+                if w.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"joining worker {wid} died during startup "
+                        f"(rc={w.proc.returncode})")
+                st = self.probe_fn(w)
+                if st is not None and getattr(st, "ok", False):
+                    w.healthy_once = True
+                    return w
+                time.sleep(0.2)
+            raise RuntimeError(
+                f"joining worker {wid} not ready within "
+                f"{wait_ready_s:.0f}s")
+        except BaseException:
+            # a raising probe (monitor wraps the same call) must not
+            # strand a half-joined worker supervised: the caller sees
+            # the failure, so the joiner must be fully unwound
+            self._abandon_join(w)
+            raise
+
+    def _abandon_join(self, w: SupervisedWorker) -> None:
+        """Failed join cleanup: unsupervise, then stop whatever process
+        is CURRENTLY attached — the monitor may have respawned the
+        worker while add_worker was still polling readiness, and that
+        respawn must not outlive supervision as an orphan."""
+        with self._lock:
+            self.workers.pop(w.wid, None)
+        if w.proc is not None and w.proc.poll() is None:
+            w.proc.terminate()
+
+    def remove_worker(self, wid: int, join_s: float = 10.0) -> bool:
+        """Drain-free LEAVE support: unsupervise the worker (so the
+        monitor cannot respawn it), push the graceful stop token — the
+        server finishes the frame it already read, answers it, and
+        exits 0 — then escalate to SIGTERM/SIGKILL only if the drain
+        stalls. Call AFTER the membership commit moved its shards.
+        Returns True when the worker exited 0 (a clean drain)."""
+        from .server import stop_server
+
+        with self._lock:
+            w = self.workers.pop(wid, None)
+        if w is None:
+            log.warning("supervisor: worker %d is not supervised", wid)
+            return False
+        if w.proc is not None and w.proc.poll() is None:
+            stop_server(w.fifo, deadline_s=2.0)
+            try:
+                w.proc.wait(timeout=join_s)
+            except subprocess.TimeoutExpired:
+                log.warning("supervisor: worker %d drain stalled; "
+                            "escalating", wid)
+                w.proc.terminate()
+                try:
+                    w.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+                    w.proc.wait(timeout=5.0)
+        rc = w.proc.returncode if w.proc is not None else None
+        log.info("supervisor: worker %d left the fleet (rc=%s)", wid, rc)
+        return rc == 0
+
     # ---------------------------------------------------- obs endpoints
     def health(self) -> dict:
         """``/healthz``: ok iff every supervised worker process is
         currently running (a worker mid-backoff reports unhealthy —
         exactly when an orchestrator should hold traffic)."""
+        workers = self._snapshot()
         running = sum(
-            1 for w in self.workers.values()
+            1 for w in workers
             if w.proc is not None and w.proc.poll() is None)
-        return {"ok": running == len(self.workers),
-                "alive": running, "workers": len(self.workers)}
+        return {"ok": running == len(workers),
+                "alive": running, "workers": len(workers)}
 
     def statusz(self) -> dict:
         """``/statusz`` section: per-worker process/respawn/ping state."""
         workers = {}
-        for w in self.workers.values():
+        for w in self._snapshot():
             workers[str(w.wid)] = {
                 "pid": w.proc.pid if w.proc is not None else None,
                 "running": (w.proc is not None
@@ -260,7 +354,7 @@ class WorkerSupervisor:
         h = self.health()
         return {"alive": h["alive"], "workers_total": h["workers"],
                 "respawns": sum(w.respawns
-                                for w in self.workers.values()),
+                                for w in self._snapshot()),
                 "ping_interval_s": self.ping_interval_s,
                 "workers": workers}
 
@@ -272,7 +366,7 @@ class WorkerSupervisor:
     def _monitor(self) -> None:
         while not self._stop.wait(self.ping_interval_s):
             alive = 0
-            for w in self.workers.values():
+            for w in self._snapshot():
                 if self._stop.is_set():
                     return
                 try:
@@ -327,15 +421,34 @@ class WorkerSupervisor:
             return
         if now < w.next_spawn_at:
             return
+        with self._lock:
+            if self.workers.get(w.wid) is not w:
+                # unsupervised between ticks (remove_worker / a failed
+                # add_worker): respawning now would orphan a process
+                # nothing manages
+                return
         w.next_spawn_at = 0.0
         w.backoff_k += 1
         w.ping_failures = 0
         w.healthy_once = False      # reset backoff only after a good ping
-        w.proc = self.spawn_fn(w)
-        w.respawns += 1
+        proc = self.spawn_fn(w)     # outside the lock: spawning blocks
+        with self._lock:
+            # re-check after the spawn: remove_worker can win the race
+            # between the pre-spawn identity check and spawn_fn — the
+            # process must not be published into an unsupervised entry
+            adopted = self.workers.get(w.wid) is w
+            if adopted:
+                w.proc = proc
+                w.respawns += 1
+        if not adopted:
+            log.warning("supervisor: worker %d unsupervised during "
+                        "respawn; terminating orphan pid %d", w.wid,
+                        proc.pid)
+            proc.terminate()
+            return
         M_RESPAWNS.inc()
         log.warning("supervisor: respawned worker %d (pid %d, "
-                    "respawn #%d)", w.wid, w.proc.pid, w.respawns)
+                    "respawn #%d)", w.wid, proc.pid, w.respawns)
 
 
 def supervise_forever(conf: ClusterConfig, conf_path: str,

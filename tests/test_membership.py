@@ -1,0 +1,1023 @@
+"""Elastic fleet membership: epoch-versioned partition tables,
+drain-free join/leave, and the reconfiguration controller.
+
+Tier-1 gates: epoch-0 identity tables stay byte-identical to the
+pre-elastic system (conf wire, routing, wire knobs); epoch/owner
+columns round-trip under the unknown-column compat contract; the
+server's version gate refuses only NEWER epochs (after a membership
+refresh) and always serves older ones; join/leave commit atomically
+with crash-resumable catch-up; the serving frontend dual-reads a
+moving shard; ``dos-obs top`` tolerates mixed statusz schemas. The
+mid-campaign join+leave chaos drill stays behind ``slow``.
+"""
+
+import csv
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.cli import process_query as pq
+from distributed_oracle_search_tpu.data import (
+    ensure_synth_dataset, read_scen,
+)
+from distributed_oracle_search_tpu.data.graph import Graph
+from distributed_oracle_search_tpu.models.cpd import (
+    adopt_shard_blocks, build_replica_shards, build_worker_shard,
+    shard_block_name, write_index_manifest,
+)
+from distributed_oracle_search_tpu.obs import fleet as obs_fleet
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel import membership as fleet
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController, parse_conf,
+)
+from distributed_oracle_search_tpu.serving import (
+    EngineDispatcher, HedgeConfig, ServeConfig, ServingFrontend,
+)
+from distributed_oracle_search_tpu.testing import faults
+from distributed_oracle_search_tpu.transport import (
+    fifo as fifo_transport,
+)
+from distributed_oracle_search_tpu.transport.wire import (
+    RuntimeConfig, STALE_EPOCH_LINE, StatsRow,
+)
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker import FifoServer, stop_server
+
+pytestmark = pytest.mark.membership
+
+N_WORKERS = 3
+
+
+def _counter(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot()["counters"].get(name, 0)
+
+
+def _gauge(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot()["gauges"].get(name, 0)
+
+
+# ------------------------------------------------- conf wire round-trips
+
+def test_epoch0_identity_conf_byte_identical():
+    """The legacy wire format must not move: an epoch-0 identity table
+    (R=1 AND R=2) emits no epoch/owner columns."""
+    dc = DistributionController("mod", 4, 4, 12, block_size=2)
+    lines = dc.format_conf().split("\n")
+    assert lines[0] == "node,wid,bid,bidx"
+    assert all(len(ln.split(",")) == 4 for ln in lines[1:])
+    dc2 = DistributionController("mod", 4, 4, 12, block_size=2,
+                                 replication=2)
+    assert dc2.format_conf().split("\n")[0] == "node,wid,bid,bidx,rep1"
+
+
+def test_epoch_conf_round_trip():
+    owners = [0, 5, 2, 3]
+    dc = DistributionController("mod", 4, 4, 32, block_size=4,
+                                epoch=7, owners=owners)
+    text = dc.format_conf()
+    assert text.split("\n")[0] == "node,wid,bid,bidx,epoch,owner"
+    p = parse_conf(text)
+    assert p["epoch"] == 7
+    np.testing.assert_array_equal(
+        p["owner"], np.asarray(owners)[p["wid"]])
+    # the first four columns are untouched — a legacy positional
+    # consumer still routes on the primary shard
+    tab = dc.table()
+    for i, k in enumerate(("node", "wid", "bid", "bidx")):
+        np.testing.assert_array_equal(p[k], tab[:, i])
+
+
+def test_parse_conf_legacy_is_epoch0():
+    legacy = "node,wid,bid,bidx\n0,0,0,0\n1,1,0,0"
+    p = parse_conf(legacy)
+    assert p["epoch"] == 0
+    np.testing.assert_array_equal(p["owner"], p["wid"])
+
+
+def test_parse_conf_unknown_columns_and_mixed_epochs():
+    # unknown columns tolerated wherever they appear
+    text = ("node,future,wid,bid,bidx,epoch,owner\n"
+            "0,9,0,0,0,3,2\n1,9,1,0,0,3,1")
+    p = parse_conf(text)
+    assert p["epoch"] == 3 and list(p["owner"]) == [2, 1]
+    # a table mixing epochs is torn state, not tolerable ambiguity
+    torn = ("node,wid,bid,bidx,epoch,owner\n"
+            "0,0,0,0,3,0\n1,1,0,0,4,1")
+    with pytest.raises(ValueError, match="mixes epochs"):
+        parse_conf(torn)
+
+
+def test_owner_validation():
+    with pytest.raises(ValueError, match="owners"):
+        DistributionController("mod", 4, 4, 16, owners=[0, 1])
+    with pytest.raises(ValueError, match="epoch"):
+        DistributionController("mod", 4, 4, 16, epoch=-1)
+
+
+# --------------------------------------------------- wire knob + sentinel
+
+def test_runtime_config_epoch_wire_compat():
+    rc = RuntimeConfig(epoch=4)
+    assert RuntimeConfig.from_json(rc.to_json()).epoch == 4
+    # an old peer's payload has no epoch key -> default 0; a new
+    # payload read by old-style filtering keeps working (unknown keys
+    # dropped symmetrically)
+    assert RuntimeConfig.from_json('{"itrs": 2}').epoch == 0
+    d = json.loads(rc.to_json())
+    d["some_future_knob"] = True
+    assert RuntimeConfig.from_json(json.dumps(d)).epoch == 4
+
+
+def test_stale_epoch_stats_sentinel():
+    row = StatsRow(ok=False, stale_epoch=True)
+    assert row.encode_wire() == STALE_EPOCH_LINE
+    back = StatsRow.decode(STALE_EPOCH_LINE)
+    assert not back.ok and back.stale_epoch
+    # an annotated sentinel ("STALE_EPOCH 3") still decodes
+    back2 = StatsRow.decode(STALE_EPOCH_LINE + " 3")
+    assert not back2.ok and back2.stale_epoch
+    # a normal failure row stays FAIL
+    assert StatsRow.failed().encode_wire() == "FAIL"
+
+
+# ----------------------------------------------- owner-aware routing
+
+def test_owner_aware_replica_routing():
+    dc = DistributionController("mod", 4, 4, 64, replication=2,
+                                epoch=1, owners=[4, 1, 2, 3])
+    # shard 0's chain slots are shards {0, 1}; their owners host it
+    assert dc.replica_workers(0) == [4, 1]
+    assert dc.replica_rank(0, 4) == 0 and dc.replica_rank(0, 1) == 1
+    with pytest.raises(ValueError):
+        dc.replica_rank(0, 2)
+    # worker 4 hosts exactly the shards whose chain slots it owns:
+    # shard 0 (owner) and shard 3 (its rank-1 slot is shard 0)
+    assert dc.replica_shards(4) == [0, 3]
+    assert 0 in dc.replica_shards(1)
+    # the dead-remap routes around the dead OWNER to the live host
+    qs = np.stack([np.zeros(8, np.int64),
+                   np.arange(8, dtype=np.int64)], axis=1)
+    groups = dc.group_queries(qs, dead={4})
+    shard0 = qs[dc.worker_of(qs[:, 1]) == 0]
+    assert len(groups[1]) >= len(shard0)     # shard 0 fell to worker 1
+
+
+# ----------------------------------------------- membership state file
+
+def test_state_round_trip_and_compat(tmp_path):
+    outdir = str(tmp_path)
+    assert fleet.load_state(outdir) is None
+    assert fleet.current_epoch(outdir) == 0
+    st = fleet.MembershipState(epoch=2, workers=["a", "b"],
+                               owners=[1, 0])
+    fleet.save_state(outdir, st)
+    back = fleet.load_state(outdir)
+    assert back.epoch == 2 and back.owners == [1, 0]
+    assert fleet.current_epoch(outdir) == 2
+    # unknown keys tolerated (future fields cannot break this reader)
+    raw = json.load(open(fleet.state_path(outdir)))
+    raw["future_key"] = {"x": 1}
+    json.dump(raw, open(fleet.state_path(outdir), "w"))
+    assert fleet.load_state(outdir).epoch == 2
+    # only NEWER schema versions reject (the manifest-compat contract)
+    raw["version"] = fleet.MEMBERSHIP_VERSION + 1
+    json.dump(raw, open(fleet.state_path(outdir), "w"))
+    with pytest.raises(ValueError, match="schema"):
+        fleet.load_state(outdir)
+
+
+# ------------------------------------------------------ built world
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    """3-worker world, R=2 replicated index + manifest (the replica
+    chains are what leave transfers ownership onto)."""
+    datadir = str(tmp_path_factory.mktemp("membership-data"))
+    paths = ensure_synth_dataset(datadir, width=8, height=6,
+                                 n_queries=45, seed=29)
+    outdir = os.path.join(datadir, "index")
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", N_WORKERS, N_WORKERS, g.n,
+                                replication=2)
+    for wid in range(N_WORKERS):
+        build_worker_shard(g, dc, wid, outdir)
+        build_replica_shards(g, dc, wid, outdir)
+    write_index_manifest(outdir, dc)
+    return datadir, paths, outdir, g, dc
+
+
+def _fresh_world(world, tmp_path, name, diffs=("-",), replication=2):
+    """A per-test copy of the built index (membership state mutates the
+    index dir; tests must not see each other's epochs)."""
+    datadir, paths, outdir, g, dc = world
+    my_out = str(tmp_path / f"index-{name}")
+    shutil.copytree(outdir, my_out)
+    conf = ClusterConfig(
+        workers=["localhost"] * N_WORKERS,
+        partmethod="mod", partkey=N_WORKERS,
+        outdir=my_out, xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=list(diffs), nfs=str(tmp_path), replication=replication,
+    ).validate()
+    my_dc = DistributionController("mod", N_WORKERS, N_WORKERS, g.n,
+                                   replication=replication)
+    return conf, g, my_dc, my_out
+
+
+# ------------------------------------------------- controller: join
+
+def test_join_window_and_commit(world, tmp_path):
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "join")
+    mc = fleet.MembershipController(conf, dc, graph=g)
+    assert mc.epoch == 0
+    m0 = _counter("reshard_migrations_total")
+    s0 = _counter("reshard_shards_moved_total")
+    mig = mc.begin(mc.plan_join("localhost"), host="localhost")
+    assert mig.worker == N_WORKERS and len(mig.moves) == 1
+    moved = mig.moves[0][0]
+    # dual-read window: old owner authoritative, adopter second
+    cands = mc.candidates_for(moved)
+    assert cands[0] == moved and cands[1] == N_WORKERS
+    # epoch does NOT bump at begin
+    assert fleet.current_epoch(outdir) == 0
+    a0 = _counter("reshard_blocks_adopted_total")
+    mc.catch_up(mig)
+    assert _counter("reshard_blocks_adopted_total") > a0
+    state = mc.commit(mig)
+    assert state.epoch == 1
+    assert state.owners[moved] == N_WORKERS
+    assert fleet.current_epoch(outdir) == 1
+    assert _gauge("reshard_epoch") == 1
+    assert _counter("reshard_migrations_total") - m0 == 1
+    assert _counter("reshard_shards_moved_total") - s0 == 1
+    # post-commit routing leads with the adopter
+    assert mc.candidates_for(moved)[0] == N_WORKERS
+    # a fresh reader derives the same view
+    dc2 = fleet.apply_state(dc, fleet.load_state(outdir))
+    assert dc2.epoch == 1 and dc2.owner_of(moved) == N_WORKERS
+
+
+def test_leave_transfers_to_replica_first(world, tmp_path):
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "leave")
+    mc = fleet.MembershipController(conf, dc, graph=g)
+    mig = mc.begin(mc.plan_leave(1))
+    # shard 1's replica chain is (1, 2) at R=2: worker 2 already holds
+    # the rows — ownership transfers to the replica first
+    assert mig.moves == [[1, 1, 2]]
+    mc.catch_up(mig)
+    state = mc.commit(mig)
+    assert state.epoch == 1 and state.owners == [0, 2, 2]
+    # the leaver now owns nothing; its former shard routes to worker 2
+    dc2 = fleet.apply_state(dc, state)
+    assert dc2.replica_workers(1)[0] == 2
+
+
+def test_commit_requires_catchup_and_abort_restores(world, tmp_path):
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "abort")
+    mc = fleet.MembershipController(conf, dc, graph=g)
+    mig = mc.begin(mc.plan_join("localhost"), host="localhost")
+    with pytest.raises(ValueError, match="catch-up"):
+        mc.commit(mig)
+    ab0 = _counter("reshard_aborted_total")
+    st = mc.abort(mig)
+    assert st.epoch == 0 and st.migration is None
+    assert len(st.workers) == N_WORKERS      # roster entry dropped
+    assert _counter("reshard_aborted_total") - ab0 == 1
+    # double begin is refused while a window is open
+    mig2 = mc.begin(mc.plan_join("localhost"), host="localhost")
+    with pytest.raises(ValueError, match="in flight"):
+        mc.begin(mc.plan_join("x"))
+    mc.abort(mig2)
+
+
+def test_catch_up_crash_resume(world, tmp_path, monkeypatch):
+    """kill-during-reshard between moves: the journal keeps the done
+    list, a fresh controller resumes exactly the tail and commits."""
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "crash")
+    mc = fleet.MembershipController(conf, dc, graph=g)
+    # force a 2-move migration: leave moves BOTH of worker 0's and 1's
+    # shards? leave(0) moves one shard; craft a join with 2 moves
+    mig = fleet.Migration(epoch=1, kind="join", worker=N_WORKERS,
+                          moves=[[0, 0, N_WORKERS], [1, 1, N_WORKERS]])
+    mc.begin(mig, host="localhost")
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS",
+                       "kill-during-reshard;mode=raise;times=1")
+    with pytest.raises(RuntimeError, match="kill-during-reshard"):
+        mc.catch_up(mig)
+    monkeypatch.delenv("DOS_FAULTS")
+    faults.reset()
+    # the first move is journaled; the window is still open
+    st = fleet.load_state(outdir)
+    assert st.epoch == 0
+    assert st.live_migration.done == [0]
+    # a brand-new controller (the restarted process) resumes the tail
+    mc2 = fleet.MembershipController(conf, dc, graph=g)
+    state = mc2.resume()
+    assert state.epoch == 1
+    assert state.owners[0] == N_WORKERS
+    assert state.owners[1] == N_WORKERS
+
+
+def test_adopt_heals_corrupt_block(world, tmp_path):
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "heal")
+    victim = shard_block_name(2, 0)
+    with open(os.path.join(outdir, victim), "r+b") as f:
+        f.seek(64)
+        f.write(b"\x55" * 16)
+    report = adopt_shard_blocks(g, dc, 2, outdir)
+    assert report["healed"] == [victim]
+    # idempotent: a second pass verifies clean
+    again = adopt_shard_blocks(g, dc, 2, outdir)
+    assert again["healed"] == [] and again["ok"] == again["blocks"]
+
+
+# --------------------------------------------------- server epoch gate
+
+def test_server_epoch_gate(world, tmp_path, monkeypatch):
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "gate")
+    server = FifoServer(conf, 0, command_fifo=str(tmp_path / "w0.fifo"))
+    assert server.epoch == 0
+    # older/equal epochs always pass
+    assert server._epoch_gate(RuntimeConfig()) is None
+    assert server._epoch_gate(RuntimeConfig(epoch=0)) is None
+    # newer epoch with no newer state on disk -> STALE_EPOCH
+    g0 = _counter("server_stale_epoch_total")
+    row = server._epoch_gate(RuntimeConfig(epoch=1))
+    assert row is not None and row.stale_epoch and not row.ok
+    assert _counter("server_stale_epoch_total") - g0 == 1
+    # once the commit lands on disk the gate refreshes and serves
+    st = fleet.MembershipState(epoch=1,
+                               workers=["localhost"] * N_WORKERS,
+                               owners=[0, 1, 2])
+    fleet.save_state(outdir, st)
+    assert server._epoch_gate(RuntimeConfig(epoch=1)) is None
+    assert server.epoch == 1
+    # and older-epoch traffic is STILL served after the bump (the
+    # dual-read window depends on it)
+    assert server._epoch_gate(RuntimeConfig(epoch=0)) is None
+
+
+def test_stale_epoch_reply_fault(world, tmp_path, monkeypatch):
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "gatefault")
+    server = FifoServer(conf, 1, command_fifo=str(tmp_path / "w1.fifo"))
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "stale-epoch-reply;wid=1;times=1")
+    row = server._epoch_gate(RuntimeConfig())
+    assert row is not None and row.stale_epoch
+    # the rule fired once; the next frame serves normally
+    assert server._epoch_gate(RuntimeConfig()) is None
+    monkeypatch.delenv("DOS_FAULTS")
+    faults.reset()
+
+
+def test_server_serves_adopted_shard_after_commit(world, tmp_path):
+    """The drain-free join, worker side: a server whose wid is outside
+    the original roster owns nothing at epoch 0, then serves its
+    adopted shard after the commit is visible."""
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "adopt-serve")
+    qs = read_scen(conf.scenfile)
+    # commit an epoch moving shard 0 to the new worker 3
+    mc = fleet.MembershipController(conf, dc, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[0, 0, 3]])
+    mc.begin(mig, host="localhost")
+    mc.catch_up(mig)
+    mc.commit(mig)
+    server = FifoServer(conf, 3, command_fifo=str(tmp_path / "w3.fifo"))
+    assert server.engine is None or server.engine.shard == 0
+    shard0 = qs[dc.worker_of(qs[:, 1]) == 0][:6]
+    from distributed_oracle_search_tpu.transport.wire import (
+        Request, write_query_file,
+    )
+    qfile = str(tmp_path / "query.adopt")
+    write_query_file(qfile, shard0)
+    row = server._handle(Request(RuntimeConfig(epoch=1), qfile,
+                                 str(tmp_path / "ans"), "-"))
+    assert row.ok and row.finished == len(shard0)
+
+
+# ------------------------------------------------ frontend dual-read
+
+class _FailingVia:
+    """Dispatcher wrapper that fails every batch sent via one worker."""
+
+    def __init__(self, inner, dead_via):
+        self.inner = inner
+        self.dead = dead_via
+
+    def answer_batch(self, wid, queries, rconf, diff, via=None):
+        if (wid if via is None else via) == self.dead:
+            raise RuntimeError("injected: via-worker down")
+        return self.inner.answer_batch(wid, queries, rconf, diff,
+                                       via=via)
+
+
+def test_frontend_dual_read_window(world, tmp_path):
+    """During a migration window the frontend walks old-owner -> adopter:
+    with the old owner down, every moving-shard request is answered by
+    the adopter lane, zero sheds."""
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "dualread",
+                                       replication=1)
+    qs = read_scen(conf.scenfile)
+    mc = fleet.MembershipController(conf, dc, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[1, 1, 3]])
+    mc.begin(mig, host="localhost")
+    mc.catch_up(mig)                     # window open, NOT committed
+    assert mc.candidates_for(1) == [1, 3]
+    disp = EngineDispatcher(conf, graph=g, dc=dc)
+    fe = ServingFrontend(
+        mc.dc_view(), _FailingVia(disp, dead_via=1),
+        sconf=ServeConfig(max_batch=16, max_wait_ms=2.0,
+                          queue_depth=256, cache_bytes=0,
+                          deadline_ms=60_000.0),
+        hconf=HedgeConfig(enabled=False), membership=mc)
+    fe.start()
+    f0 = _counter("failover_total")
+    try:
+        shard1 = qs[dc.worker_of(qs[:, 1]) == 1][:8]
+        res = [fe.query(int(s), int(t), timeout=60) for s, t in shard1]
+    finally:
+        fe.stop()
+    assert all(r.ok for r in res)
+    assert _counter("failover_total") - f0 >= 1
+    # answers match the primary engine's
+    eng_disp = EngineDispatcher(conf, graph=g, dc=dc)
+    c, p, fin = eng_disp.answer_batch(1, shard1, RuntimeConfig(), "-")
+    for i, r in enumerate(res):
+        assert (r.cost, r.plen, r.finished) == (int(c[i]), int(p[i]),
+                                                bool(fin[i]))
+
+
+def test_frontend_r1_admission_sees_adopter(world, tmp_path):
+    """R=1 admission during a dual-read window: the moving shard's old
+    owner has an OPEN breaker, but the adopter is live — requests must
+    pass admission and be served via failover, not shed circuit-open.
+    A steady (single-candidate) shard with an open breaker still sheds,
+    pinning the legacy R=1 trial semantics."""
+    from distributed_oracle_search_tpu.transport import resilience
+
+    conf, g, dc, outdir = _fresh_world(world, tmp_path, "r1admission",
+                                       replication=1)
+    qs = read_scen(conf.scenfile)
+    mc = fleet.MembershipController(conf, dc, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[1, 1, 3]])
+    mc.begin(mig, host="localhost")
+    mc.catch_up(mig)                     # window open, NOT committed
+    registry = resilience.BreakerRegistry(threshold=1, cooldown_s=600.0,
+                                          enabled=True)
+    registry.record(1, ok=False)         # old owner: OPEN
+    registry.record(0, ok=False)         # a steady shard: OPEN
+    fe = ServingFrontend(
+        mc.dc_view(), EngineDispatcher(conf, graph=g, dc=dc),
+        sconf=ServeConfig(max_batch=16, max_wait_ms=2.0,
+                          queue_depth=256, cache_bytes=0,
+                          deadline_ms=60_000.0),
+        registry=registry, hconf=HedgeConfig(enabled=False),
+        membership=mc)
+    fe.start()
+    try:
+        shard1 = qs[dc.worker_of(qs[:, 1]) == 1][:6]
+        res = [fe.query(int(s), int(t), timeout=60) for s, t in shard1]
+        assert all(r.ok for r in res), [(r.status, r.detail)
+                                        for r in res]
+        s0, t0 = qs[dc.worker_of(qs[:, 1]) == 0][0]
+        steady = fe.query(int(s0), int(t0), timeout=60)
+        assert not steady.ok and steady.detail == "circuit-open"
+    finally:
+        fe.stop()
+
+
+# --------------------------------------------------------- wire sweep
+
+def test_clean_stale_epoch_files(tmp_path):
+    nfs = str(tmp_path)
+    old = ["query.localhost1.s0.e2", "answer.localhost1.s0.e2.a0",
+           "query.localhost3.e1"]
+    keep_young = "query.localhost1.s0.e3"
+    keep_plain = ["query.localhost1", "answer.localhost1.a0"]
+    for name in old + [keep_young] + keep_plain:
+        with open(os.path.join(nfs, name), "w") as f:
+            f.write("x")
+    past = time.time() - 120
+    for name in old + keep_plain:
+        os.utime(os.path.join(nfs, name), (past, past))
+    s0 = _counter("artifacts_swept_total")
+    n = fifo_transport.clean_stale_epoch_files(nfs)
+    assert n == len(old)
+    assert _counter("artifacts_swept_total") - s0 == len(old)
+    left = set(os.listdir(nfs))
+    assert keep_young in left                 # age-gated
+    assert all(k in left for k in keep_plain)  # non-epoch names kept
+    assert not any(o in left for o in old)
+
+
+# ------------------------------------------------------- dos-obs top
+
+def test_top_tolerates_mixed_statusz_schemas():
+    """A rolling upgrade mixes new workers (epoch/migration keys) with
+    old ones (no such keys) and the odd garbage payload — every one is
+    a row, never a crash."""
+    statuses = {
+        "new:1": {"serving": {"epoch": 3, "shards": {},
+                              "migration": {"kind": "join", "epoch": 4,
+                                            "moves": [[0, 0, 3]],
+                                            "done": []}}},
+        "newworker:2": {"worker": {"batches": 7, "batch_failures": 0,
+                                   "epoch": 3}},
+        "legacy:3": {"worker": {"batches": 5, "batch_failures": 1}},
+        "garbage:4": {"serving": "not-a-dict", "worker": 17,
+                      "breakers": ["weird"]},
+        "dead:5": {"error": "ConnectionRefusedError: ..."},
+        "nulls:6": {"serving": {"shards": {"w0": {"queue_depth": None},
+                                           "w1": {"queue_depth": "?"}},
+                                "hedge": {"rate": None}},
+                    "worker": {"batches": None},
+                    "supervisor": {"alive": "yes"}},
+    }
+    table = obs_fleet.render_top(statuses)
+    lines = table.split("\n")
+    assert len(lines) == len(statuses) + 2       # header + rule + rows
+    assert "epoch" in lines[0] and "migration" in lines[0]
+    row_new = next(ln for ln in lines if ln.startswith("new:1"))
+    assert "join->e4 0/1" in row_new
+    row_legacy = next(ln for ln in lines if ln.startswith("legacy:3"))
+    assert " - " in row_legacy                   # blanks, not a crash
+    row_dead = next(ln for ln in lines if ln.startswith("dead:5"))
+    assert "UNREACHABLE" in row_dead
+    row_nulls = next(ln for ln in lines if ln.startswith("nulls:6"))
+    assert "up" in row_nulls                     # non-numeric scalars
+    # render as defaults, not a TypeError out of the sum()
+
+
+def test_replica_fast_path_ignores_out_of_range_joiner():
+    """A fresh joiner's wid is past maxworker: under the identity
+    assignment it hosts NOTHING — the identity modulo must not claim
+    another worker's shard for it (that would make the server's
+    routing-invariant check silently accept a misroute)."""
+    dc = DistributionController("mod", 2, 2, 8, replication=2)
+    assert dc.replica_shards(2) == []
+    with pytest.raises(ValueError):
+        dc.replica_rank(0, 2)
+
+
+def test_plan_join_share_counts_live_owners(tmp_path):
+    """The joiner's balanced share divides by workers that OWN shards,
+    not roster slots — departed workers keep their positional roster
+    entry and must not dilute the share."""
+    import types
+
+    dc = DistributionController("mod", 6, 6, 18)
+    conf = types.SimpleNamespace(workers=[f"h{i}" for i in range(6)],
+                                 outdir=str(tmp_path))
+    mc = fleet.MembershipController(conf, dc)
+    mc.state.owners = [0, 0, 0, 1, 1, 1]    # workers 2-5 departed
+    mig = mc.plan_join("hnew")
+    assert len(mig.moves) == 2              # 6 shards // (2 live + 1)
+    assert all(to == 6 for _s, _f, to in mig.moves)
+
+
+# --------------------------------------------- campaign (non-slow)
+
+def _thread_servers(conf, fifo_dir, monkeypatch, wids):
+    os.makedirs(fifo_dir, exist_ok=True)
+    fifos = {wid: os.path.join(fifo_dir, f"worker{wid}.fifo")
+             for wid in wids}
+    monkeypatch.setattr(pq, "command_fifo_path", lambda wid: fifos[wid])
+    servers = {wid: FifoServer(conf, wid, command_fifo=fifos[wid])
+               for wid in wids}
+    threads = {wid: threading.Thread(target=s.serve_forever,
+                                     daemon=True)
+               for wid, s in servers.items()}
+    for t in threads.values():
+        t.start()
+    for fifo in fifos.values():
+        for _ in range(100):
+            if os.path.exists(fifo):
+                break
+            time.sleep(0.02)
+    return fifos, threads
+
+
+def _stop_all(fifos, threads):
+    for fifo in fifos.values():
+        stop_server(fifo, deadline_s=5.0)
+    for t in threads.values():
+        t.join(timeout=15)
+
+
+def _answer_columns(outdir):
+    """parts.csv minus the timing columns — the deterministic answer
+    payload of a campaign."""
+    with open(os.path.join(outdir, "parts.csv")) as fh:
+        rows = list(csv.reader(fh))
+    hdr = rows[0]
+    keep = [hdr.index(k) for k in
+            ("expe", "n_expanded", "n_touched", "plen", "finished",
+             "size")]
+    return [[r[i] for i in keep] for r in rows[1:]]
+
+
+def test_campaign_routes_by_committed_epoch(world, tmp_path,
+                                            monkeypatch):
+    """A campaign under a committed epoch (shard 0 owned by the joined
+    worker 3) exits 0 with answers bit-identical to the static-fleet
+    run — ownership moved, answers did not."""
+    monkeypatch.setenv("DOS_RETRY_MAX", "0")
+    monkeypatch.setenv("DOS_SEND_TIMEOUT_S", "15")
+    faults.reset()
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+
+    # static golden run
+    conf_a, g, dc, _out_a = _fresh_world(world, tmp_path, "static",
+                                         diffs=["-", "-"])
+    conf_a_path = str(tmp_path / "conf-static.json")
+    conf_a.save(conf_a_path)
+    fifos, threads = _thread_servers(conf_a, str(tmp_path / "f0"),
+                                     monkeypatch, range(N_WORKERS))
+    out0 = str(tmp_path / "artifacts-static")
+    try:
+        rc = pq.main(["-c", conf_a_path, "--backend", "host",
+                      "-o", out0])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN
+
+    # elastic run: commit the join first, then serve with 4 workers
+    conf_b, g, dc, out_b = _fresh_world(world, tmp_path, "elastic",
+                                        diffs=["-", "-"])
+    conf_b_path = str(tmp_path / "conf-elastic.json")
+    conf_b.save(conf_b_path)
+    mc = fleet.MembershipController(conf_b, dc, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[0, 0, 3]])
+    mc.begin(mig, host="localhost")
+    mc.catch_up(mig)
+    mc.commit(mig)
+    fifos, threads = _thread_servers(conf_b, str(tmp_path / "f1"),
+                                     monkeypatch, range(N_WORKERS + 1))
+    out1 = str(tmp_path / "artifacts-elastic")
+    try:
+        rc = pq.main(["-c", conf_b_path, "--backend", "host",
+                      "-o", out1])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN
+    assert not os.path.exists(os.path.join(out1, "degraded.json"))
+    assert _answer_columns(out0) == _answer_columns(out1)
+
+
+# ------------------------------------------------- the chaos drill
+
+@pytest.mark.slow
+def test_chaos_join_and_leave_mid_campaign(world, tmp_path,
+                                           monkeypatch):
+    """The acceptance drill: a worker JOIN and a worker LEAVE are both
+    injected while a campaign runs. The campaign exits 0, writes no
+    degraded.json, its answer columns are bit-identical to the
+    static-fleet run, and the reshard_epoch gauge shows the committed
+    bumps (join -> 1, leave -> 2)."""
+    monkeypatch.setenv("DOS_RETRY_MAX", "0")
+    monkeypatch.setenv("DOS_SEND_TIMEOUT_S", "15")
+    n_rounds = 8
+    # identical reply-delay fault in BOTH runs: it paces the rounds so
+    # the reconfigurations genuinely overlap the campaign, without
+    # perturbing the (deterministic) answer payload
+    pace = "delay;delay=0.12;times=inf"
+
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", pace)
+    conf_a, g, dc, _ = _fresh_world(world, tmp_path, "chaos-static",
+                                    diffs=["-"] * n_rounds)
+    conf_a_path = str(tmp_path / "conf-cs.json")
+    conf_a.save(conf_a_path)
+    fifos, threads = _thread_servers(conf_a, str(tmp_path / "cf0"),
+                                     monkeypatch, range(N_WORKERS))
+    out0 = str(tmp_path / "chaos-golden")
+    try:
+        rc = pq.main(["-c", conf_a_path, "--backend", "host",
+                      "-o", out0])
+    finally:
+        _stop_all(fifos, threads)
+    assert rc == pq.EXIT_CLEAN
+
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", pace)
+    conf_b, g, dc, out_b = _fresh_world(world, tmp_path, "chaos-live",
+                                        diffs=["-"] * n_rounds)
+    conf_b_path = str(tmp_path / "conf-cl.json")
+    conf_b.save(conf_b_path)
+    fifo_dir = str(tmp_path / "cf1")
+    fifos, threads = _thread_servers(conf_b, fifo_dir, monkeypatch,
+                                     range(N_WORKERS))
+    # the joiner's server starts inside the drill, on the same fifo map
+    fifos[3] = os.path.join(fifo_dir, "worker3.fifo")
+    out1 = str(tmp_path / "chaos-answers")
+    campaign_rc = {}
+
+    def _campaign():
+        campaign_rc["rc"] = pq.main(
+            ["-c", conf_b_path, "--backend", "host", "-o", out1])
+
+    th = threading.Thread(target=_campaign, daemon=True)
+    th.start()
+    try:
+        time.sleep(0.4)                      # round 0 in flight
+        mc = fleet.MembershipController(conf_b, dc, graph=g)
+        # ---- JOIN: worker 3 adopts one shard, serving from the start
+        mig = mc.begin(mc.plan_join("localhost"), host="localhost")
+        joiner = FifoServer(conf_b, 3, command_fifo=fifos[3])
+        jth = threading.Thread(target=joiner.serve_forever, daemon=True)
+        jth.start()
+        threads[3] = jth
+        for _ in range(100):
+            if os.path.exists(fifos[3]):
+                break
+            time.sleep(0.02)
+        mc.catch_up(mig)
+        mc.commit(mig)                       # epoch 1: routing flips
+        time.sleep(0.4)                      # a round runs at epoch 1
+        # ---- LEAVE: worker 1's shard transfers to its replica host,
+        # then the worker drains and exits 0
+        mig2 = mc.begin(mc.plan_leave(1))
+        mc.catch_up(mig2)
+        mc.commit(mig2)                      # epoch 2
+        assert stop_server(fifos[1], deadline_s=5.0)
+        threads[1].join(timeout=15)
+        assert not threads[1].is_alive()     # drained clean
+    finally:
+        th.join(timeout=120)
+        _stop_all({w: f for w, f in fifos.items() if w != 1}, {
+            w: t for w, t in threads.items() if w != 1})
+    assert not th.is_alive(), "campaign wedged"
+    assert campaign_rc.get("rc") == pq.EXIT_CLEAN
+    assert not os.path.exists(os.path.join(out1, "degraded.json"))
+    assert _gauge("reshard_epoch") == 2
+    assert fleet.current_epoch(out_b) == 2
+    assert _answer_columns(out0) == _answer_columns(out1)
+    faults.reset()
+
+
+# ---------------------------------------------- review-hardening pins
+
+def test_leave_fallback_never_targets_departed_worker(world, tmp_path):
+    """R=1: after C leaves, its roster slot remains (ids are
+    positional) — a later leave's round-robin fallback must pick from
+    workers that still OWN shards, never the drained slot."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "leave-r1",
+                                        replication=1)
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    mig = mc.begin(mc.plan_leave(2))
+    mc.catch_up(mig)
+    mc.commit(mig)                        # worker 2 drained, slot kept
+    assert 2 not in mc.state.owners
+    mig2 = mc.plan_leave(0)               # R=1: chains are the leaver
+    targets = {to for _s, _f, to in mig2.moves}
+    assert 2 not in targets               # never the departed worker
+    assert targets <= set(mc.state.owners)
+
+
+def test_reader_controller_observes_external_commit(world, tmp_path):
+    """A long-lived serving-side controller must pick up commits made
+    by ANOTHER process (throttled re-read of membership.json)."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "xproc",
+                                        replication=1)
+    reader = fleet.MembershipController(conf, dc1, graph=g)
+    assert reader.candidates_for(0) == [0]
+    writer = fleet.MembershipController(conf, dc1, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[0, 0, 3]])
+    writer.begin(mig, host="localhost")
+    writer.catch_up(mig)
+    writer.commit(mig)
+    reader._last_refresh = 0.0            # force the throttle window
+    assert reader.candidates_for(0)[0] == 3
+    assert reader.epoch == 1
+
+
+def test_server_learns_window_on_hosted_miss(world, tmp_path):
+    """A worker started BEFORE a migration window opens (no epoch bump
+    at begin) must refresh on the first dual-read batch instead of
+    refusing it — 'no query is shed during handoff'."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "window-miss",
+                                        replication=1)
+    qs = read_scen(conf.scenfile)
+    # worker 2's server starts under the static epoch-0 table
+    server = FifoServer(conf, 2, command_fifo=str(tmp_path / "wm.fifo"))
+    # another process opens a window adopting shard 0 ONTO worker 2
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    mig = fleet.Migration(epoch=1, kind="leave", worker=0,
+                          moves=[[0, 0, 2]])
+    mc.begin(mig)
+    mc.catch_up(mig)                      # window open, not committed
+    shard0 = qs[dc1.worker_of(qs[:, 1]) == 0][:4]
+    from distributed_oracle_search_tpu.transport.wire import (
+        Request, write_query_file,
+    )
+    qfile = str(tmp_path / "query.window")
+    write_query_file(qfile, shard0)
+    row = server._handle(Request(RuntimeConfig(), qfile,
+                                 str(tmp_path / "ans"), "-"))
+    assert row.ok and row.finished == len(shard0)
+
+
+def test_refresh_never_rolls_epoch_back(world, tmp_path):
+    """A lagging read (NFS cache, a restored stale file) must not roll
+    a controller's routing back to a drained owner: refresh ignores an
+    OLDER on-disk epoch; same-epoch content (a window opened without a
+    bump) still applies."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "rollback",
+                                        replication=1)
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[0, 0, 3]])
+    mc.begin(mig, host="localhost")
+    mc.catch_up(mig)
+    committed = mc.commit(mig)
+    assert committed.epoch == 1 and mc.candidates_for(0)[0] == 3
+    # an operator restores yesterday's epoch-0 state file
+    fleet.save_state(outdir, fleet.MembershipState(
+        epoch=0, workers=["localhost"] * N_WORKERS,
+        owners=list(range(N_WORKERS))))
+    mc.refresh()
+    assert mc.epoch == 1                  # older state ignored
+    assert mc.candidates_for(0)[0] == 3   # routing did not roll back
+    # same-epoch content changes still apply (window without a bump)
+    newer = fleet.MembershipState(
+        epoch=1, workers=committed.workers, owners=committed.owners,
+        migration=fleet.Migration(epoch=2, kind="leave", worker=1,
+                                  moves=[[1, 1, 2]]).to_dict())
+    fleet.save_state(outdir, newer)
+    mc.refresh()
+    assert mc.state.migration is not None
+
+
+def test_dc_view_cache_invalidated_across_mutations(world, tmp_path):
+    """dc_view's per-generation cache must never pin a pre-commit
+    controller: every mutation point bumps the generation, so a cache
+    entry built from pre-mutation state can't be mistaken for current
+    (the reader-preempted-across-a-commit race)."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "dcgen",
+                                        replication=1)
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    gen0 = mc._state_gen
+    before = mc.dc_view()
+    assert before.owner_of(0) == 0
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[0, 0, 3]])
+    mc.begin(mig, host="localhost")
+    mc.catch_up(mig)
+    mc.commit(mig)
+    assert mc._state_gen > gen0
+    # a racing reader stuffing the PRE-commit controller back into the
+    # cache under the OLD generation must not survive the next view
+    mc._dc_cache = (gen0, before)
+    assert mc.dc_view().owner_of(0) == 3
+
+
+def test_round_membership_degrades_to_last_good_pair(world, tmp_path):
+    """The campaign's per-round membership re-read must degrade to the
+    last-good (table, roster) PAIR: an elastic owner table whose joined
+    worker ids are past the static conf roster would otherwise wrap
+    onto the wrong hosts."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "lastgood",
+                                        replication=1)
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[0, 0, 3]])
+    mc.begin(mig, host="joiner-host")
+    mc.catch_up(mig)
+    mc.commit(mig)
+    mview, dc_r, hosts = pq._round_membership(conf, dc1)
+    assert dc_r.owner_of(0) == 3 and hosts[3] == "joiner-host"
+    last = (mview, dc_r, hosts)
+    # the state file becomes unreadable mid-campaign
+    with open(fleet.state_path(outdir), "w") as fh:
+        fh.write("{torn")
+    assert pq._round_membership(conf, dc1, last=last) == last
+    # ... or vanishes entirely: same degrade, never a mixed pair
+    os.remove(fleet.state_path(outdir))
+    assert pq._round_membership(conf, dc1, last=last) == last
+    # a static fleet (no state, no last-good) keeps the static pair
+    mview2, dc2, hosts2 = pq._round_membership(conf, dc1)
+    assert mview2 is None and dc2 is dc1
+    assert hosts2 == list(conf.workers)
+
+
+def test_frontend_statusz_reports_live_chains(world, tmp_path):
+    """/statusz replica chains must be the LIVE candidate chains
+    dispatch walks, not the construction-time static ones — during a
+    migration window they are exactly what an operator is debugging."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "statusz-live",
+                                        replication=1)
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[0, 0, 3]])
+    mc.begin(mig, host="localhost")
+    disp = EngineDispatcher(conf, g, dc1)
+    fe = ServingFrontend(
+        dc1, disp,
+        sconf=ServeConfig(max_batch=16, max_wait_ms=2.0,
+                          queue_depth=64, cache_bytes=0,
+                          deadline_ms=5_000.0),
+        hconf=HedgeConfig(enabled=False), membership=mc)
+    try:
+        fe.start()
+        chains = {int(w): s["replicas"]
+                  for w, s in fe.statusz()["shards"].items()}
+        # dual-read window: old owner authoritative, adopter second
+        assert chains[0] == [0, 3]
+        assert chains[1] == [1]
+    finally:
+        fe.stop()
+
+
+def test_group_queries_dead_remap_reaches_joined_worker():
+    """The dead-remap buckets over the ids actually present: an owner
+    table naming a JOINED worker (wid >= maxworker) must receive its
+    queries, not have them silently vanish outside a fixed
+    range(maxworker) walk."""
+    dc = DistributionController("mod", 4, 4, 100, epoch=1,
+                                owners=[0, 4, 2, 3])
+    qs = np.stack([np.zeros(12, np.int64),
+                   np.arange(12, dtype=np.int64)], axis=1)
+    groups = dc.group_queries(qs, dead=[2])
+    assert sum(len(g) for g in groups.values()) == len(qs)
+    assert 4 in groups and len(groups[4]) == 3      # shard 1 -> w4
+    from distributed_oracle_search_tpu.parallel.partition import (
+        UNROUTABLE,
+    )
+    assert UNROUTABLE in groups                     # shard 2: chain dead
+    assert list(groups) == sorted(groups)           # -1 first, ascending
+
+
+def test_plan_join_records_host(world, tmp_path):
+    """plan_join's host rides the Migration record, so begin rosters
+    the host the plan was made for without the caller passing it
+    twice (an explicit begin(host=...) still wins)."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "planhost",
+                                        replication=1)
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    mig = mc.plan_join("joiner-host")
+    assert mig.host == "joiner-host"
+    mc.begin(mig)
+    assert mc.state.workers[-1] == "joiner-host"
+    mc.abort(mig)
+    mig2 = mc.plan_join("planned-host")
+    mc.begin(mig2, host="explicit-host")
+    assert mc.state.workers[-1] == "explicit-host"
+
+
+def test_refresh_keeps_dc_cache_on_unchanged_state(world, tmp_path):
+    """Steady-state refresh (same on-disk content) must not invalidate
+    the dc_view cache: the admission hot path would otherwise re-run
+    the O(N) node assignment once per refresh interval."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "steadyref",
+                                        replication=1)
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    fleet.save_state(outdir, mc.state)
+    view = mc.dc_view()
+    gen = mc._state_gen
+    mc.refresh()
+    assert mc._state_gen == gen
+    assert mc.dc_view() is view
+
+
+def test_round_membership_stale_epoch_and_bad_owners(world, tmp_path):
+    """The campaign's per-round re-read carries the other read paths'
+    guards: an OLDER on-disk epoch never rolls the round's routing
+    back, unchanged content reuses the previous round's controller
+    (no per-round O(N) rebuild), and a state whose owners do not fit
+    the partition degrades instead of crashing the round."""
+    conf, g, dc1, outdir = _fresh_world(world, tmp_path, "roundguards",
+                                        replication=1)
+    mc = fleet.MembershipController(conf, dc1, graph=g)
+    mig = fleet.Migration(epoch=1, kind="join", worker=3,
+                          moves=[[0, 0, 3]], host="joiner-host")
+    mc.begin(mig)
+    mc.catch_up(mig)
+    mc.commit(mig)
+    last = pq._round_membership(conf, dc1)
+    assert last[1].owner_of(0) == 3
+    # unchanged content: the very same triple comes back (identity —
+    # the controller is reused, not rebuilt)
+    assert pq._round_membership(conf, dc1, last=last) == last
+    # an operator restores yesterday's epoch-0 file mid-campaign
+    fleet.save_state(outdir, fleet.MembershipState(
+        epoch=0, workers=["localhost"] * N_WORKERS,
+        owners=list(range(N_WORKERS))))
+    assert pq._round_membership(conf, dc1, last=last) == last
+    # owners that do not fit this partition degrade, not crash
+    fleet.save_state(outdir, fleet.MembershipState(
+        epoch=2, workers=["localhost"] * N_WORKERS, owners=[0, 1]))
+    assert pq._round_membership(conf, dc1, last=last) == last
+    # ... and with no last-good either, the static pair survives
+    mview, dc_r, hosts = pq._round_membership(conf, dc1)
+    assert mview is None and dc_r is dc1 and hosts == list(conf.workers)

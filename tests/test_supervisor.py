@@ -169,3 +169,55 @@ def test_supervisor_env_knobs(monkeypatch):
     assert sup.backoff_base_s == 0.25
     assert sup.backoff_cap_s == 3
     assert sup.unhealthy_pings == 5
+
+
+def test_supervisor_add_and_remove_worker():
+    """Elastic membership support: a worker joins the supervised set
+    without touching the running fleet, and a leave drains it clean —
+    monitor keeps running throughout, never respawns the leaver."""
+    sup = _mk(2)
+    sup.start(wait_ready_s=10)
+    try:
+        w = sup.add_worker(2)
+        assert w.proc.poll() is None and w.healthy_once
+        assert sup.health() == {"ok": True, "alive": 3, "workers": 3}
+        assert "2" in sup.statusz()["workers"]
+        with pytest.raises(ValueError, match="already supervised"):
+            sup.add_worker(2)
+        # leave: unsupervised first, then stopped (dummies have no
+        # stop-token reader, so the drain escalates to SIGTERM — the
+        # seam under test is supervision, not the server's drain)
+        assert 2 in sup.workers
+        sup.remove_worker(2, join_s=1.0)
+        assert 2 not in sup.workers
+        assert w.proc.poll() is not None
+        time.sleep(0.2)          # monitor ticks: no respawn of a leaver
+        assert sup.health()["workers"] == 2
+        assert sup.remove_worker(7) is False     # unknown wid: no-op
+    finally:
+        sup.stop()
+
+
+def test_add_worker_unwinds_on_raising_probe():
+    """A probe that RAISES during the readiness poll (an anticipated
+    mode — the monitor wraps the same call) must not strand a
+    half-joined worker supervised: the joiner is fully unwound so the
+    caller can retry."""
+    def boom(w):
+        raise OSError("probe transport down")
+
+    sup = _mk(2, probe_fn=boom)
+    # no start(): the seam under test is add_worker's own cleanup
+    with pytest.raises(OSError, match="probe transport down"):
+        sup.add_worker(2)
+    assert 2 not in sup.workers
+    sup2 = _mk(2)
+    sup2.probe_fn = boom
+    try:
+        with pytest.raises(OSError):
+            sup2.add_worker(2)
+        sup2.probe_fn = _alive_probe
+        w = sup2.add_worker(2, wait_ready_s=10)   # retry succeeds
+        assert w.healthy_once
+    finally:
+        sup2.stop()
