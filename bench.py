@@ -35,6 +35,10 @@ grid + arterials; see ``data/synth.py``). Sections (env-gated):
   reshard    elastic-membership drill — serve q/s + p99 steady vs
              through a LIVE worker join (dual-read migration window,
              epoch bump committed mid-load)        (BENCH_RESHARD=0 skips)
+  traffic    live congestion plane — zipf hotspot pool served through a
+             rush-hour segment replay swapping diff epochs under the
+             running frontend: live-swap q/s, swap-stall p99, scoped
+             cache-invalidation hit rate          (BENCH_TRAFFIC=0 skips)
 
 All speedups are against a MEASURED native-engine run on this host's
 cpu_cores core(s); *_parity_cores fields give the OpenMP core count a
@@ -1855,6 +1859,167 @@ def main() -> None:
             f" q/s (epoch {mc.epoch} committed, {ok_mg}/{en} ok)")
         shutil.rmtree(edir, ignore_errors=True)
 
+    # ---- traffic section: the live congestion plane (traffic/). A zipf
+    # hotspot pool served steady on the base weights, then again while a
+    # rush-hour segment replay swaps diff epochs UNDER the running
+    # frontend — live-swap q/s, swap-stall p99, and the scoped-vs-full
+    # invalidation hit rate (how much of the warm cache survives a swap
+    # because its paths provably avoid the retimed corridor).
+    # BENCH_TRAFFIC=0 skips.
+    traffic_stats = {}
+    if os.environ.get("BENCH_TRAFFIC", "1") != "0":
+        from distributed_oracle_search_tpu.data import ensure_synth_dataset
+        from distributed_oracle_search_tpu.data.graph import Graph
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.obs import (
+            metrics as _tmetrics,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            EngineDispatcher, HedgeConfig, ServeConfig, ServingFrontend,
+        )
+        from distributed_oracle_search_tpu.traffic import DiffEpochManager
+        from distributed_oracle_search_tpu.traffic import (
+            scenarios as tscen,
+        )
+        from distributed_oracle_search_tpu.transport.wire import (
+            RuntimeConfig,
+        )
+        from distributed_oracle_search_tpu.utils.config import (
+            ClusterConfig,
+        )
+
+        log("traffic (live epoch swaps over a zipf hotspot pool)...")
+        tdir = tempfile.mkdtemp(prefix="bench-traffic-")
+        tpaths = ensure_synth_dataset(tdir, width=24, height=18,
+                                      n_queries=512, seed=41)
+        tconf = ClusterConfig(
+            workers=["localhost"] * 2, partmethod="mod", partkey=2,
+            outdir=os.path.join(tdir, "index"),
+            xy_file=tpaths["xy"], scenfile=tpaths["scen"],
+            nfs=tdir).validate()
+        tg = Graph.from_xy(tconf.xy_file)
+        tdc = DistributionController("mod", 2, 2, tg.n)
+        for wid in range(2):
+            build_worker_shard(tg, tdc, wid, tconf.outdir)
+        write_index_manifest(tconf.outdir, tdc)
+        tn = int(os.environ.get("BENCH_TRAFFIC_REQUESTS", 2048))
+        tpool = tscen.zipf_queries(tg.n, tn, seed=41)
+        tdisp = EngineDispatcher(tconf, graph=tg, dc=tdc)
+        stream_dir = os.path.join(tdir, "stream")
+        tmgr = DiffEpochManager(stream_dir, poll_ms=25.0)
+        # warm every micro-batch bucket shape off the clock with the
+        # serve path's own knobs (sig_k rides the program key): the
+        # live burst's post-swap misses arrive in odd-sized batches,
+        # and a first-swap XLA compile must not masquerade as swap
+        # stall — steady-state swaps are compile-free
+        twconf = RuntimeConfig(sig_k=tmgr.sig_moves)
+        for wid in range(2):
+            mine = tpool[tdc.worker_of(tpool[:, 1]) == wid]
+            for b in (1, 2, 4, 8, 16, 32, 64):
+                if len(mine) >= b:
+                    tdisp.answer_batch(wid, mine[:b], twconf, "-")
+        tfe = ServingFrontend(
+            tdc, tdisp,
+            sconf=ServeConfig(max_batch=64, max_wait_ms=2.0,
+                              queue_depth=max(tn, 2048),
+                              deadline_ms=600_000.0).validate(),
+            hconf=HedgeConfig(enabled=False), traffic=tmgr)
+        tsnap0 = _tmetrics.REGISTRY.snapshot()["counters"]
+        tfe.start()
+
+        def _tburst(pool, during=()):
+            """Closed-loop burst through the LIVE frontend; ``during``
+            maps submit index -> hook (segment injection points), so
+            swaps land while queries flow and the post-swap misses'
+            stall shows up in this burst's p99."""
+            t0 = time.perf_counter()
+            submits, futs = [], []
+            for i, (s, t) in enumerate(pool):
+                hook = during.get(i) if during else None
+                if hook is not None:
+                    hook()
+                submits.append(time.monotonic())
+                futs.append(tfe.submit(int(s), int(t)))
+            res = [f.result(600) for f in futs]
+            wall = time.perf_counter() - t0
+            lat = [(r.t_done - ts) * 1e3
+                   for r, ts in zip(res, submits) if r.ok]
+            return sum(r.ok for r in res), wall, lat
+
+        try:
+            _tburst(tpool)       # warm: engines compiled, cache filled
+            ok_td, wall_td, lat_td = _tburst(tpool)   # steady, epoch 0
+            p99_td = (float(np.percentile(lat_td, 99))
+                      if lat_td else float("nan"))
+            log(f"  steady (epoch 0): {ok_td}/{tn} ok "
+                f"({ok_td / wall_td:,.0f} q/s, p99 {p99_td:.1f} ms)")
+
+            # the same burst again, but rush-hour segments land at 1/3
+            # and 2/3 of the stream (epoch 2 is the tent peak) and each
+            # injection waits for the pump to APPLY the swap, so the
+            # rest of the burst genuinely runs on the new fused diff —
+            # re-keyed survivors hitting, affected entries re-answered
+            trace = tscen.rush_hour_trace(tg, epochs=3, frac=0.02,
+                                          peak=3.0, seed=41)
+
+            def _inject(seg):
+                def hook():
+                    tscen.replay([seg], stream_dir)
+                    deadline = time.monotonic() + 30.0
+                    while (tfe._diff_epoch < seg["epoch"]
+                           and time.monotonic() < deadline):
+                        time.sleep(0.005)
+                return hook
+
+            ok_tl, wall_tl, lat_tl = _tburst(
+                tpool, during={len(tpool) // 3: _inject(trace[0]),
+                               (2 * len(tpool)) // 3: _inject(trace[1])})
+            p99_tl = (float(np.percentile(lat_tl, 99))
+                      if lat_tl else float("nan"))
+            swapped = int(tfe._diff_epoch)
+            log(f"  live swap: {ok_tl}/{tn} ok "
+                f"({ok_tl / wall_tl:,.0f} q/s, p99 {p99_tl:.1f} ms, "
+                f"{swapped} epoch(s) applied)")
+
+            # scoped-invalidation hit rate straight from the swap
+            # passes' own accounting: survivors re-keyed / entries
+            # examined. (NOT a post-swap resubmission probe — the live
+            # burst re-caches the hot pool under the new epoch, so a
+            # probe would read near-1.0 even with scoped invalidation
+            # fully broken.)
+            tsnap = _tmetrics.REGISTRY.snapshot()["counters"]
+
+            def _tdelta(name):
+                return int(tsnap.get(name, 0)) - int(tsnap0.get(name, 0))
+
+            kept = _tdelta("serve_cache_rekeyed_total")
+            sdrop = _tdelta("serve_cache_invalidated_scoped_total")
+            traffic_stats = {
+                "traffic_steady_queries_per_sec": round(
+                    ok_td / wall_td, 1),
+                "traffic_steady_p99_ms": round(p99_td, 3),
+                "traffic_live_swap_queries_per_sec": round(
+                    ok_tl / wall_tl, 1),
+                "traffic_swap_stall_p99_ms": round(p99_tl, 3),
+                "traffic_epochs_swapped": swapped,
+                "traffic_scoped_hit_rate": round(
+                    kept / (kept + sdrop), 4) if kept + sdrop else 0.0,
+                "traffic_invalidated_scoped": sdrop,
+                "traffic_invalidated_full": _tdelta(
+                    "serve_cache_invalidated_full_total"),
+            }
+            log(f"traffic: steady "
+                f"{traffic_stats['traffic_steady_queries_per_sec']:,.0f}"
+                f" q/s -> live-swap "
+                f"{traffic_stats['traffic_live_swap_queries_per_sec']:,.0f}"
+                f" q/s, scoped hit rate "
+                f"{traffic_stats['traffic_scoped_hit_rate']:.0%}")
+        finally:
+            tfe.stop()
+        shutil.rmtree(tdir, ignore_errors=True)
+
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     detail = {
         "graph_nodes": g.n,
@@ -1902,6 +2067,7 @@ def main() -> None:
         **serve_stats,
         **repl_stats,
         **reshard_stats,
+        **traffic_stats,
         "devices": len(devices),
         "platform": devices[0].platform,
     }
@@ -1946,6 +2112,8 @@ def main() -> None:
         "shard_strong_scaling_rows_per_sec",
         "serve_queries_per_sec", "serve_p99_ms",
         "serve_cache_hit_rate", "serve_mean_batch_fill",
+        "traffic_live_swap_queries_per_sec", "traffic_swap_stall_p99_ms",
+        "traffic_scoped_hit_rate",
         "devices", "platform",
     )
     headline = {k: detail[k] for k in headline_keys if k in detail}

@@ -130,6 +130,13 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                            "crashed ones with capped exponential "
                            "backoff (local hosts only; see "
                            "worker.supervisor).")
+    fifo.add_argument("--traffic-dir", default=None,
+                      help="make_fifos --supervise: diff segment "
+                           "stream directory passed to every spawned "
+                           "worker.server, so supervised workers gate "
+                           "requests from diff epochs their filesystem "
+                           "view has not seen yet (STALE_DIFF) instead "
+                           "of failing the fused-file open.")
     fifo.add_argument("--alg", default="table-search",
                       choices=["table-search", "astar", "ch"],
                       help="Serving algorithm — honored by BOTH backends "

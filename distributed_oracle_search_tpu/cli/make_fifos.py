@@ -97,7 +97,10 @@ def main(argv=None) -> int:
                              f"the supervisor on each worker host")
         return supervise_forever(conf, conf_path, alg=args.alg,
                                  obs_port=getattr(args, "obs_port",
-                                                  None))
+                                                  None),
+                                 traffic_dir=getattr(args,
+                                                     "traffic_dir",
+                                                     None))
     procs = []
     for wid in range(conf.maxworker):
         if args.worker != -1 and wid != args.worker:

@@ -80,6 +80,17 @@ def build_parser() -> argparse.ArgumentParser:
     bd.add_argument("--key-tolerance", action="append", default=[],
                     metavar="KEY=FRAC",
                     help="per-key tolerance override (repeatable)")
+    bd.add_argument("--waive", action="append", default=[],
+                    metavar="KEY=ROUND",
+                    help="record a per-key baseline waiver: accept "
+                         "KEY's regression when the NEW record is "
+                         "round ROUND (e.g. scale_build_rows_per_sec"
+                         "=r05); written to BENCH_WAIVERS.json in "
+                         "--dir so the acceptance is reviewed with "
+                         "the diff (repeatable)")
+    bd.add_argument("--waive-reason", default="",
+                    help="why the waived regression is accepted "
+                         "(recorded alongside --waive)")
     return p
 
 
@@ -148,14 +159,49 @@ def _cmd_bench_diff(args) -> int:
         except ValueError:
             raise SystemExit(f"bad --key-tolerance {spec!r} "
                              "(want KEY=FRACTION)")
+    # record any --waive KEY=ROUND pairs first, then gate with the full
+    # recorded set: the waiver mechanism accepts a REVIEWED baseline
+    # shift (the file lands in the repo diff) without deleting history
+    old_nums = fleet.bench_numbers(old_path)
+    new_nums = fleet.bench_numbers(new_path)
+    new_round = fleet.bench_round(new_path)
+    for spec in args.waive:
+        key, _, rnd = spec.partition("=")
+        if not key or not rnd:
+            raise SystemExit(f"bad --waive {spec!r} (want KEY=ROUND, "
+                             "e.g. scale_build_rows_per_sec=r05)")
+        if rnd != new_round:
+            # a waiver only fires when the NEWEST record is its round;
+            # recording one that cannot apply would print 'recorded'
+            # and then gate anyway — reject it up front
+            raise SystemExit(
+                f"--waive {spec!r} cannot apply: the newest record is "
+                + (f"round {new_round!r}" if new_round else
+                   f"{new_path!r} (not a canonical BENCH_rNN name, so "
+                   "no waiver can match it)"))
+        entry = {"reason": args.waive_reason}
+        if key in old_nums:
+            entry["old"] = old_nums[key]
+        if key in new_nums:
+            entry["new"] = new_nums[key]
+        fleet.record_waiver(args.dir, key, rnd, entry)
+        print(f"  recorded waiver {key}={rnd} in "
+              f"{fleet.WAIVER_FILE}")
     out = fleet.compare_bench(old_path, new_path,
                               tolerance=args.tolerance,
-                              key_tolerances=key_tol)
+                              key_tolerances=key_tol,
+                              waivers=fleet.load_waivers(args.dir))
     print(f"bench-diff: {out['old']} -> {out['new']} "
           f"({out['checked']} shared keys)")
     for e in out["improved"]:
         print(f"  + {e['key']}: {e['old']:g} -> {e['new']:g} "
               f"(x{e['ratio']:.2f})")
+    for e in out["waived"]:
+        reason = e.get("waiver", {}).get("reason", "")
+        print(f"  ~ WAIVED {e['key']}: {e['old']:g} -> {e['new']:g} "
+              f"(x{e['ratio']:.2f}, recorded for "
+              f"{e['waiver'].get('round', '?')}"
+              + (f": {reason}" if reason else "") + ")")
     for e in out["regressions"]:
         print(f"  ! REGRESSION {e['key']}: {e['old']:g} -> "
               f"{e['new']:g} (x{e['ratio']:.2f}, "

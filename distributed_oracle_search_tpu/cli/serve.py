@@ -86,6 +86,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(DOS_SERVE_CACHE_BYTES)")
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="per-request deadline (DOS_SERVE_DEADLINE_MS)")
+    p.add_argument("--traffic-dir", default=None,
+                   help="diff segment stream directory: swap the "
+                        "active congestion diff LIVE as epoch-tagged "
+                        "segments land (no restart; scoped cache "
+                        "invalidation)")
+    p.add_argument("--traffic-spool", default=None,
+                   help="where fused per-epoch diff files materialize "
+                        "(default <traffic-dir>/fused; must be "
+                        "worker-visible for --backend host)")
     p.add_argument("--metrics-dump", default="",
                    help="write a JSON metrics snapshot here on shutdown")
     p.add_argument("--obs-port", type=int, default=None,
@@ -145,10 +154,34 @@ def build_frontend(conf: ClusterConfig, args):
         breaker_key = lambda wid: (mc.host_of(wid), wid)  # noqa: E731
     if mstate is not None:
         log.info("serving under membership epoch %d", mc.epoch)
+    # live traffic: a segment stream turns the static --diff into the
+    # BASE of a rolling fusion; the frontend's epoch pump swaps fused
+    # epochs without restart
+    traffic = None
+    if getattr(args, "traffic_dir", None):
+        from ..traffic import DiffEpochManager
+
+        traffic = DiffEpochManager(args.traffic_dir, base_diff=diff,
+                                   spool_dir=args.traffic_spool)
+        log.info("live traffic enabled: stream %s, spool %s",
+                 args.traffic_dir, traffic.spool)
     frontend = ServingFrontend(
         dc, dispatcher, sconf=sconf, rconf=rconf, diff=diff,
-        registry=registry, breaker_key=breaker_key, membership=mc)
-    return frontend, registry
+        registry=registry, breaker_key=breaker_key, membership=mc,
+        traffic=traffic)
+    # typed query families (mat/alt/rev) on the same frontend; the alt
+    # planner loads the graph lazily on its first query
+    from ..traffic import QueryFamilies
+    if args.backend == "inproc":
+        families = QueryFamilies(frontend, graph=dispatcher.graph,
+                                 traffic=traffic)
+    else:
+        from ..data.graph import Graph
+        families = QueryFamilies(
+            frontend,
+            graph_provider=lambda: Graph.from_xy(conf.xy_file),
+            traffic=traffic)
+    return frontend, registry, families
 
 
 def _dc_for(conf: ClusterConfig):
@@ -175,7 +208,7 @@ def main(argv=None) -> int:
         ensure_synth_dataset(os.path.dirname(conf.xy_file) or "./data")
     else:
         conf = ClusterConfig.load(args.c)
-    frontend, registry = build_frontend(conf, args)
+    frontend, registry, families = build_frontend(conf, args)
     frontend.start()
     obs_srv = None
     # graceful drain: SIGTERM (the orchestrator's stop signal) and
@@ -215,15 +248,16 @@ def main(argv=None) -> int:
                 "device_programs": obs_device.snapshot,
             })
         if args.ingress == "stdin":
-            n = ingress.serve_stdin(frontend)
+            n = ingress.serve_stdin(frontend, families=families)
         elif args.ingress == "socket":
             ingress.serve_unix_socket(frontend, args.socket,
-                                      stop=stop_evt)
+                                      stop=stop_evt, families=families)
             n = None
         else:
             if not args.tail:
                 raise SystemExit("--ingress tail needs --tail FILE")
-            n = ingress.tail_file(frontend, args.tail, stop=stop_evt)
+            n = ingress.tail_file(frontend, args.tail, stop=stop_evt,
+                                  families=families)
         if n is not None:
             log.info("ingress closed after %d request(s)", n)
     except KeyboardInterrupt:

@@ -169,6 +169,31 @@ fleet"):
   NEWER table than the worker could see even after a membership
   refresh).
 
+Live traffic plane (``traffic/`` — streaming congestion diffs, scoped
+cache invalidation, and the typed query families; README "Live
+traffic"):
+
+* epoch swaps — ``traffic_epoch`` (gauge: the active diff epoch, 0 =
+  the static base diff), ``traffic_segments_applied_total`` (stream
+  segments fused into swaps), ``traffic_edges_updated_total`` (edges
+  whose weight actually changed), ``traffic_swap_seconds`` (segment
+  merge + fused-diff materialization per swap);
+* scoped invalidation — ``serve_cache_invalidated_scoped_total`` /
+  ``serve_cache_invalidated_full_total`` (entries dropped by reason:
+  a SCOPED pass drops only entries whose cached path touches an
+  updated edge and re-keys the provable survivors; FULL counts manual
+  diff changes and swaps past the ``DOS_TRAFFIC_SCOPED_MAX`` bound),
+  ``serve_cache_rekeyed_total`` (the survivors a SCOPED pass re-keyed
+  to the new epoch — kept / (kept + scoped-dropped) is the scoped
+  hit rate the bench headlines);
+* query families — ``serve_matrix_requests_total`` (one-to-many ETA
+  rows), ``serve_alt_requests_total`` (k-alternative routes),
+  ``serve_reverse_requests_total`` (reverse source-owner routing);
+* version gate — ``server_stale_diff_total`` (batches a worker refused
+  with the ``STALE_DIFF`` wire sentinel: fused at a NEWER diff epoch
+  than the worker's segment stream shows even after a refresh — the
+  traffic twin of ``server_stale_epoch_total``).
+
 Live observability plane (this PR's standing layer — the scrape-time
 series every resident process exposes):
 

@@ -224,6 +224,14 @@ def _summarize(status: dict) -> dict:
         if "epoch" in sec and isinstance(sec["epoch"], (int, float)):
             out["epoch"] = int(sec["epoch"])
             break
+    # live-traffic column: the active DIFF epoch — same mixed-schema
+    # tolerance (a pre-traffic endpoint's row shows a blank)
+    for sec in (serving, worker):
+        if ("diff_epoch" in sec
+                and isinstance(sec["diff_epoch"], (int, float))
+                and not isinstance(sec["diff_epoch"], bool)):
+            out["diff epoch"] = int(sec["diff_epoch"])
+            break
     mig = serving.get("migration") or worker.get("migration")
     if isinstance(mig, dict):
         moves = mig.get("moves") if isinstance(mig.get("moves"), list) \
@@ -313,17 +321,71 @@ def bench_numbers(path: str) -> dict[str, float]:
     return out
 
 
+#: recorded per-key baseline waivers live next to the BENCH_r*.json
+#: history (checked into the repo, so the acceptance is reviewable)
+WAIVER_FILE = "BENCH_WAIVERS.json"
+
+
+def bench_round(path: str) -> str:
+    """``BENCH_r05.json`` -> ``"r05"`` (empty for non-canonical
+    names — explicit OLD NEW paths can be anything)."""
+    m = re.search(r"BENCH_(r\d+)\.json$", os.path.basename(path))
+    return m.group(1) if m else ""
+
+
+def load_waivers(dirname: str) -> dict:
+    """The recorded waiver map ``{key: {"round": "rNN", ...}}``; absent
+    or unreadable file = no waivers (logged — a corrupt waiver file
+    must fail toward GATING, never toward silently passing). Unknown
+    per-entry keys are tolerated (the annotation contract of every
+    other on-disk codec here)."""
+    path = os.path.join(dirname, WAIVER_FILE)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError:
+        return {}
+    except ValueError as e:
+        log.error("unreadable %s: %s (treating as NO waivers)", path, e)
+        return {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def record_waiver(dirname: str, key: str, round_name: str,
+                  entry: dict | None = None) -> dict:
+    """Merge one waiver into the recorded file (atomic write) and
+    return the updated map. ``entry`` carries the context a reviewer
+    needs (old/new values, reason)."""
+    from ..utils.atomicio import atomic_write_bytes
+
+    waivers = load_waivers(dirname)
+    rec = {"round": round_name}
+    if entry:
+        rec.update(entry)
+    waivers[key] = rec
+    atomic_write_bytes(
+        os.path.join(dirname, WAIVER_FILE),
+        (json.dumps(waivers, indent=1, sort_keys=True) + "\n").encode())
+    return waivers
+
+
 def compare_bench(old_path: str, new_path: str,
                   tolerance: float = DEFAULT_TOLERANCE,
-                  key_tolerances: dict[str, float] | None = None) -> dict:
+                  key_tolerances: dict[str, float] | None = None,
+                  waivers: dict | None = None) -> dict:
     """Per-key regression check; returns ``{"regressions": [...],
-    "improved": [...], "checked": N, ...}``. A key present only on one
-    side is skipped (workloads grow across rounds; absence is not a
-    regression)."""
+    "improved": [...], "waived": [...], "checked": N, ...}``. A key
+    present only on one side is skipped (workloads grow across rounds;
+    absence is not a regression). A regression whose key carries a
+    recorded waiver FOR THE NEW ROUND moves to ``waived`` instead — the
+    waiver is a per-round baseline acceptance, so a fresh regression in
+    a later round gates again."""
     old = bench_numbers(old_path)
     new = bench_numbers(new_path)
     key_tolerances = key_tolerances or {}
-    regressions, improved, checked = [], [], []
+    waivers = waivers or {}
+    new_round = bench_round(new_path)
+    regressions, improved, waived, checked = [], [], [], []
     for key in sorted(set(old) & set(new)):
         tol = key_tolerances.get(key, tolerance)
         ov, nv = old[key], new[key]
@@ -336,16 +398,22 @@ def compare_bench(old_path: str, new_path: str,
                  "ratio": round(ratio, 3), "tolerance": tol,
                  "direction": "lower" if lower_better else "higher"}
         if lower_better:
-            if ratio > 1.0 + tol:
-                regressions.append(entry)
-            elif ratio < 1.0:
-                improved.append(entry)
+            regressed = ratio > 1.0 + tol
+            better = ratio < 1.0
         else:
-            if ratio < 1.0 - tol:
+            regressed = ratio < 1.0 - tol
+            better = ratio > 1.0
+        if regressed:
+            waiver = waivers.get(key)
+            if (isinstance(waiver, dict) and new_round
+                    and waiver.get("round") == new_round):
+                entry["waiver"] = waiver
+                waived.append(entry)
+            else:
                 regressions.append(entry)
-            elif ratio > 1.0:
-                improved.append(entry)
+        elif better:
+            improved.append(entry)
     return {"old": os.path.basename(old_path),
             "new": os.path.basename(new_path),
             "checked": len(checked), "regressions": regressions,
-            "improved": improved}
+            "improved": improved, "waived": waived}

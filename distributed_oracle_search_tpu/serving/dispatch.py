@@ -35,8 +35,8 @@ from ..parallel.partition import DistributionController
 from ..transport import fifo as fifo_transport
 from ..transport.fifo import answer_fifo_path, command_fifo_path
 from ..transport.wire import (
-    Request, RuntimeConfig, read_results_file, results_file_for,
-    write_query_file,
+    Request, RuntimeConfig, paths_file_for, read_paths_file,
+    read_results_file, results_file_for, write_query_file,
 )
 from ..utils.config import ClusterConfig
 from ..utils.locks import OrderedLock
@@ -77,6 +77,13 @@ class EngineDispatcher:
         self.build_missing = build_missing
         self.build_chunk = build_chunk
         self._engines: dict[tuple, object] = {}
+        #: per-(shard, via) lane serialization: an ABANDONED hedge
+        #: loser's thread can still be inside ``eng.answer`` when the
+        #: batcher dispatches the next batch to the same lane — without
+        #: the lane lock the loser's late return overwrites
+        #: ``last_paths`` under the next batch's read and scoped
+        #: invalidation re-keys entries with another batch's signatures
+        self._lane_locks: dict[tuple, OrderedLock] = {}
         self._lock = OrderedLock("serving.EngineDispatcher")
 
     def _build_missing_shard(self, shard: int, replica: int) -> None:
@@ -135,12 +142,40 @@ class EngineDispatcher:
                 self._engines[(wid, via)] = eng
             return eng
 
+    def _lane(self, wid: int, via: int | None):
+        """The lane's engine plus its serialization lock."""
+        via = wid if via is None else int(via)
+        eng = self._engine_for(wid, via)
+        with self._lock:
+            lock = self._lane_locks.setdefault(
+                (wid, via), OrderedLock("serving.EngineDispatcher.lane"))
+        return eng, lock
+
     def answer_batch(self, wid: int, queries: np.ndarray,
                      rconf: RuntimeConfig, diff: str,
                      via: int | None = None):
-        cost, plen, fin, _stats = self._engine_for(wid, via).answer(
-            queries, rconf, diff)
+        eng, lane = self._lane(wid, via)
+        with lane:
+            cost, plen, fin, _stats = eng.answer(queries, rconf, diff)
         return cost, plen, fin
+
+    def answer_batch_paths(self, wid: int, queries: np.ndarray,
+                           rconf: RuntimeConfig, diff: str,
+                           via: int | None = None):
+        """``answer_batch`` plus the batch's path prefixes — the
+        live-traffic frontend sets ``rconf.sig_k`` and keys scoped cache
+        invalidation off them. Returns ``(cost, plen, fin, nodes,
+        moves)``; the path halves are ``None`` when the engine captured
+        none. The lane lock covers the answer AND the ``last_paths``
+        read: the frontend keeps one batch in flight per lane, but an
+        ABANDONED hedge loser is still running on its lane when the
+        winner returns — without the lock its late return could
+        overwrite ``last_paths`` under this batch's read."""
+        eng, lane = self._lane(wid, via)
+        with lane:
+            cost, plen, fin, _stats = eng.answer(queries, rconf, diff)
+            nodes, moves = eng.last_paths or (None, None)
+        return cost, plen, fin, nodes, moves
 
 
 class FifoDispatcher:
@@ -203,7 +238,8 @@ class FifoDispatcher:
         import stat as _stat
 
         qfile, answer_base = prev
-        for p in (qfile, results_file_for(qfile)):
+        for p in (qfile, results_file_for(qfile),
+                  paths_file_for(qfile)):
             try:
                 os.remove(p)
             except OSError:
@@ -239,6 +275,24 @@ class FifoDispatcher:
     def answer_batch(self, wid: int, queries: np.ndarray,
                      rconf: RuntimeConfig, diff: str,
                      via: int | None = None):
+        return self._dispatch(wid, queries, rconf, diff, via,
+                              want_paths=False)
+
+    def answer_batch_paths(self, wid: int, queries: np.ndarray,
+                           rconf: RuntimeConfig, diff: str,
+                           via: int | None = None):
+        """Wire twin of :meth:`EngineDispatcher.answer_batch_paths`:
+        when ``rconf.sig_k`` (or ``extract``) made the server write a
+        ``.paths`` sidecar, read it back next to the ``.results`` one.
+        An old server that filtered the unknown key ships no sidecar —
+        the path halves come back ``None`` and the cache degrades to
+        conservative invalidation, never an error."""
+        return self._dispatch(wid, queries, rconf, diff, via,
+                              want_paths=True)
+
+    def _dispatch(self, wid: int, queries: np.ndarray,
+                  rconf: RuntimeConfig, diff: str,
+                  via: int | None, want_paths: bool):
         via = wid if via is None else int(via)
         host = self.host_of(via)
         nfs = self.conf.nfs
@@ -264,9 +318,14 @@ class FifoDispatcher:
                 host, req, command_fifo_path(via), timeout=self.timeout,
                 policy=self.policy, wid=via)
             if not row.ok:
+                detail = (" (STALE_DIFF: worker behind the diff stream)"
+                          if row.stale_diff else
+                          " (STALE_EPOCH: worker behind the partition "
+                          "table)" if row.stale_epoch else "")
                 raise DispatchError(
                     f"worker {via} on {host} failed a serving batch "
-                    f"({len(queries)} queries for shard {wid})")
+                    f"({len(queries)} queries for shard {wid})"
+                    + detail)
             try:
                 cost, plen, fin = read_results_file(
                     results_file_for(qfile))
@@ -282,7 +341,14 @@ class FifoDispatcher:
                 raise DispatchError(
                     f"worker {via} results length {len(cost)} != batch "
                     f"{len(queries)}")
-            return cost, plen, fin
+            if not want_paths:
+                return cost, plen, fin
+            nodes = moves = None
+            try:
+                nodes, moves = read_paths_file(paths_file_for(qfile))
+            except (OSError, ValueError):
+                pass       # old server / no extraction: signature-less
+            return cost, plen, fin, nodes, moves
 
 
 class CallableDispatcher:

@@ -81,9 +81,20 @@ class ServingFrontend:
                  sconf: ServeConfig | None = None,
                  rconf: RuntimeConfig | None = None,
                  diff: str = "-", registry=None, breaker_key=None,
-                 hconf: HedgeConfig | None = None, membership=None):
+                 hconf: HedgeConfig | None = None, membership=None,
+                 traffic=None):
         self.dc = dc
         self.dispatcher = dispatcher
+        #: live-traffic hook (``traffic.epochs.DiffEpochManager`` or
+        #: anything with ``refresh()``/``active()``/``statusz()`` and
+        #: the ``poll_s``/``scoped_max``/``sig_moves`` knobs): when set,
+        #: a pump thread polls the segment stream and swaps the active
+        #: fused diff on the serve path WITHOUT restart — in-flight
+        #: batches pinned the old fused file at dispatch and finish on
+        #: the old epoch; the cache invalidates scoped to the swap's
+        #: affected edges. None = the static-diff world, byte-for-byte
+        #: the pre-traffic behavior (diff epoch stays 0 everywhere).
+        self.traffic = traffic
         #: elastic-membership hook (``parallel.membership
         #: .MembershipController`` or anything with ``epoch``,
         #: ``candidates_for(shard)`` and ``statusz()``): when set, each
@@ -96,6 +107,26 @@ class ServingFrontend:
         self.sconf = sconf or ServeConfig.from_env()
         self.rconf = rconf or RuntimeConfig()
         self.diff = diff
+        #: active diff epoch (0 = static diff). Published AFTER
+        #: ``_diff_epoch`` on a swap; a torn read at worst builds a key
+        #: that matches nothing — a cache miss, never a wrong hit.
+        self._diff_epoch = 0
+        self._sig_k = 0
+        #: the fused difffile the SWAP path last published — scoped
+        #: invalidation matches survivors against this, NOT self.diff,
+        #: which a manual set_diff() can point at an unrelated file
+        #: whose entries were never computed under any fusion
+        self._fused_diff = self.diff
+        if traffic is not None:
+            # catch up to the stream before serving: a frontend started
+            # mid-campaign begins at the newest fused epoch instead of
+            # replaying the whole history one swap at a time
+            traffic.refresh()
+            self._diff_epoch, self.diff, _ = traffic.active()
+            self._fused_diff = self.diff
+            self._sig_k = max(int(traffic.sig_moves), 0)
+        self._traffic_stop = threading.Event()
+        self._traffic_thread: threading.Thread | None = None
         self.registry = registry
         self._breaker_key = breaker_key or (lambda wid: wid)
         self._fp = knob_fingerprint(self.rconf)
@@ -130,6 +161,12 @@ class ServingFrontend:
             for b in self._batchers.values():
                 b.start()
             self._started = True
+            if self.traffic is not None:
+                self._traffic_stop.clear()
+                self._traffic_thread = threading.Thread(
+                    target=self._traffic_loop, daemon=True,
+                    name="dos-serve-traffic")
+                self._traffic_thread.start()
             log.info("serving frontend up: %d shard(s), max_batch=%d, "
                      "max_wait=%.1fms, queue_depth=%d, cache=%dMB",
                      self.dc.maxworker, self.sconf.max_batch,
@@ -144,6 +181,12 @@ class ServingFrontend:
         a per-shard allowance — shutdown latency stays ~drain_s even
         with many busy shards. Idempotent."""
         self._closed = True
+        # stop the epoch pump FIRST: a swap landing mid-drain would
+        # re-key the cache under batches that will never complete
+        self._traffic_stop.set()
+        if self._traffic_thread is not None:
+            self._traffic_thread.join(timeout=5.0)
+            self._traffic_thread = None
         if self._started:
             for q in self._queues.values():
                 q.close()
@@ -168,7 +211,13 @@ class ServingFrontend:
             M_ERRORS.inc()
             return self._immediate(ServeResult(
                 ERROR, s, t, detail="node-out-of-range"), now)
-        key = (s, t, self.diff, self._fp)
+        # both epochs are in the key: a post-reshard hit must never
+        # serve a result computed by a worker that no longer owns the
+        # shard, and a post-swap hit must never serve an old fusion's
+        # cost (scoped invalidation RE-KEYS provably-safe entries, so
+        # survivors keep hitting)
+        key = (s, t, self.diff, self._fp, self._membership_epoch(),
+               int(self._diff_epoch))
         hit = self.cache.get(key)
         if hit is not None:
             cost, plen, fin = hit
@@ -250,6 +299,7 @@ class ServingFrontend:
         out = {
             "serving": self._started and not self._closed,
             "diff": self.diff,
+            "diff_epoch": int(self._diff_epoch),
             "replication": int(self.dc.replication),
             "epoch": int(self.membership.epoch
                          if self.membership is not None
@@ -269,9 +319,58 @@ class ServingFrontend:
             mstat = self.membership.statusz()
             if "migration" in mstat:
                 out["migration"] = mstat["migration"]
+        if self.traffic is not None:
+            out["traffic"] = self.traffic.statusz()
         if self.registry is not None:
             out["breakers"] = self.registry.statusz()
         return out
+
+    def _membership_epoch(self) -> int:
+        return int(self.membership.epoch if self.membership is not None
+                   else self.dc.epoch)
+
+    # ------------------------------------------------------ live traffic
+    def _traffic_loop(self) -> None:
+        """Epoch pump: poll the segment stream, swap on new epochs.
+        Never dies — a failing poll keeps serving the current epoch."""
+        while not self._traffic_stop.wait(self.traffic.poll_s):
+            try:
+                self.poll_traffic()
+            except Exception as e:  # noqa: BLE001 — the pump outlives
+                # any single bad segment batch
+                log.exception("traffic epoch pump failed: %s", e)
+
+    def poll_traffic(self) -> bool:
+        """One pump step (also callable inline from tests/tools):
+        returns True iff a new epoch was applied."""
+        if self.traffic is None or not self.traffic.refresh():
+            return False
+        self._apply_swap()
+        return True
+
+    def _apply_swap(self) -> None:
+        epoch, difffile, affected = self.traffic.active()
+        if epoch == self._diff_epoch and difffile == self.diff:
+            return
+        old_epoch = self._diff_epoch
+        # survivors must have been computed under the previous FUSION:
+        # self.diff can be a manual set_diff() target whose entries the
+        # swap's affected set says nothing about
+        old_diff = self._fused_diff
+        # epoch first, then diff: a torn read pairs the OLD diff with
+        # the NEW epoch — a key that matches nothing (miss), never a
+        # wrong hit; the caching guard in _dispatch_live pins both
+        self._diff_epoch = epoch
+        self.diff = difffile
+        self._fused_diff = difffile
+        dropped, kept, reason = self.cache.invalidate_scoped(
+            affected, difffile, epoch,
+            max_edges=self.traffic.scoped_max,
+            old_diff=old_diff, old_depoch=old_epoch)
+        log.info("diff epoch %d -> %d live swap: %d cache entries "
+                 "dropped (%s), %d re-keyed survivors, %d edge(s) "
+                 "affected", old_epoch, epoch, dropped, reason, kept,
+                 len(affected))
 
     def set_diff(self, diff: str) -> None:
         """Switch the active congestion diff. The cache is invalidated
@@ -354,13 +453,16 @@ class ServingFrontend:
 
     def _dispatch_live(self, wid: int, live: list[ServeRequest]) -> None:
         queries = np.asarray([[r.s, r.t] for r in live], np.int64)
-        # pin the diff actually dispatched: a set_diff racing this batch
-        # must not let answers computed under the NEW diff be cached
-        # under requests' submit-time (old-diff) keys
+        # pin the (diff, diff epoch) actually dispatched: a set_diff or
+        # epoch swap racing this batch must not let answers computed
+        # under the NEW fusion be cached under requests' submit-time
+        # (old-epoch) keys — and vice versa
         diff = self.diff
+        depoch = int(self._diff_epoch)
         err = ""
         ok = False
         cost = plen = fin = None
+        sigs = None
         candidates = self._candidates(wid)
         attempted = False
         failed_over = False
@@ -379,9 +481,9 @@ class ServingFrontend:
                             "host w%d", wid, via)
             attempted = True
             try:
-                cost, plen, fin = self._dispatch_hedged(
+                cost, plen, fin, sigs = self._dispatch_hedged(
                     wid, via, candidates, queries, diff,
-                    tid=live[0].trace_id)
+                    depoch=depoch, tid=live[0].trace_id)
                 ok = True
             except Exception as e:  # noqa: BLE001 — any dispatch
                 # failure becomes a breaker failure record (booked by
@@ -409,17 +511,22 @@ class ServingFrontend:
             return
         for i, r in enumerate(live):
             val = (int(cost[i]), int(plen[i]), bool(fin[i]))
-            if r.key[2] == diff:
-                self.cache.put(r.key, val)
+            if (r.key[2] == diff
+                    and (len(r.key) <= 5 or r.key[5] == depoch)):
+                self.cache.put(r.key, val,
+                               sig=sigs[i] if sigs is not None
+                               else None)
             M_OK.inc()
             self._finish(r, ServeResult(OK, r.s, r.t, cost=val[0],
                                         plen=val[1], finished=val[2]))
 
     # ------------------------------------------------- hedged dispatch
     def _answer_once(self, wid: int, via: int, queries, diff: str,
-                     tid: str = ""):
-        """One dispatch lane. ``tid`` is the batch's trace id: it tags
-        this thread (hedge lanes run on fresh threads that would
+                     depoch: int = 0, tid: str = ""):
+        """One dispatch lane; returns ``(cost, plen, fin, sigs)`` where
+        ``sigs`` is a per-query path-signature list (or None when no
+        signatures were captured). ``tid`` is the batch's trace id: it
+        tags this thread (hedge lanes run on fresh threads that would
         otherwise be untagged), rides the wire so a FIFO worker captures
         its spans under it, and labels the dispatch span."""
         rconf = self.rconf
@@ -429,14 +536,48 @@ class ServingFrontend:
             # the wire carries the table version the routing decision
             # was made under (elastic-membership wire extension)
             rconf = dataclasses.replace(rconf, epoch=epoch)
+        if depoch and not rconf.diff_epoch:
+            # the traffic twin: the diff epoch this batch's fused file
+            # was pinned at (tolerate-older / gate-newer on the worker)
+            rconf = dataclasses.replace(rconf, diff_epoch=int(depoch))
         if tid:
             obs_trace.set_trace_id(tid)
             if not rconf.trace_id:
                 rconf = dataclasses.replace(rconf, trace_id=tid)
+        want_sigs = (self._sig_k > 0 and self.cache.enabled
+                     and hasattr(self.dispatcher,
+                                 "answer_batch_paths"))
         with obs_trace.span("serve.dispatch", wid=via, shard=wid,
                             size=len(queries)):
-            return self.dispatcher.answer_batch(
+            if want_sigs:
+                rconf = dataclasses.replace(rconf, sig_k=self._sig_k)
+                cost, plen, fin, nodes, moves = (
+                    self.dispatcher.answer_batch_paths(
+                        wid, queries, rconf, diff, via=via))
+                return cost, plen, fin, self._build_sigs(
+                    plen, nodes, moves)
+            cost, plen, fin = self.dispatcher.answer_batch(
                 wid, queries, rconf, diff, via=via)
+            return cost, plen, fin, None
+
+    def _build_sigs(self, plen, nodes, moves):
+        """Per-query path signatures: the walked node set, or None when
+        the capture is INCOMPLETE (path longer than ``sig_k`` — such an
+        entry must invalidate conservatively on every swap)."""
+        if nodes is None or moves is None:
+            return None
+        if len(nodes) != len(plen) or len(moves) != len(plen):
+            # not this batch's capture (defense in depth next to the
+            # dispatcher's lane lock): no signatures beats wrong ones
+            return None
+        sigs = []
+        for i in range(len(plen)):
+            if int(moves[i]) == int(plen[i]):
+                sigs.append(frozenset(
+                    int(x) for x in nodes[i, :int(moves[i]) + 1]))
+            else:
+                sigs.append(None)
+        return sigs
 
     def _hedge_target(self, wid: int, via: int, candidates) -> int | None:
         """The replica a hedge would duplicate to: the first candidate
@@ -455,7 +596,8 @@ class ServingFrontend:
             self.registry.record(self._breaker_key(target), ok)
 
     def _dispatch_hedged(self, wid: int, via: int, candidates,
-                         queries, diff: str, tid: str = ""):
+                         queries, diff: str, depoch: int = 0,
+                         tid: str = ""):
         """One batch through ``via``, hedged: if no answer lands within
         the shard's adaptive delay (recent latency quantile, floor
         ``DOS_HEDGE_MIN_MS``) and the hedge budget grants, a duplicate
@@ -484,7 +626,8 @@ class ServingFrontend:
             # hedge anyway)
             t0 = time.monotonic()
             try:
-                out = self._answer_once(wid, via, queries, diff, tid=tid)
+                out = self._answer_once(wid, via, queries, diff,
+                                        depoch=depoch, tid=tid)
             except Exception:
                 self._record(via, False)
                 raise
@@ -500,7 +643,7 @@ class ServingFrontend:
             t0 = time.monotonic()
             try:
                 r = self._answer_once(wid, target, queries, diff,
-                                      tid=tid)
+                                      depoch=depoch, tid=tid)
             except Exception as e:  # noqa: BLE001 — collected below
                 self._record(target, False)
                 results.put((is_hedge, None, e, time.monotonic() - t0))

@@ -4,9 +4,20 @@ Protocol (one request per line, responses in request order):
 
     request:   ``<s> <t>``            (node ids; blank lines and
                                        ``#`` comments are skipped)
+               ``mat <s> <t1> ... <tk>``   one-to-many ETA matrix row
+               ``alt <s> <t> <k>``         k-alternative routes
+               ``rev <s> <t>``             reverse (return-trip) route
     response:  ``OK <s> <t> <cost> <plen> <finished> [cached]``
+               ``MAT <s> <k> <c1> ... <ck>``    (-1 = unanswered)
+               ``ALT <s> <t> <n> <c1> ... <cn>`` (ascending, n <= k)
+               ``REV <s> <t> <cost> <plen> <finished>``
                ``BUSY|UNAVAILABLE|TIMEOUT|ERROR <s> <t> [detail]``
     control:   ``quit``               closes the session
+
+The typed family sentences (``traffic.families``) are accepted only
+when the caller wires a :class:`~..traffic.QueryFamilies` planner;
+without one they answer ``ERROR`` like any malformed line, so a plain
+pair-only deployment's protocol surface is unchanged.
 
 The reader NEVER blocks per request — it submits and moves on, which is
 what lets back-to-back lines coalesce into real micro-batches; a writer
@@ -40,12 +51,14 @@ def parse_query_line(line: str) -> tuple[int, int]:
 
 
 def serve_stream(frontend: ServingFrontend, rfile, wfile,
-                 result_timeout_s: float | None = None) -> int:
+                 result_timeout_s: float | None = None,
+                 families=None) -> int:
     """Run the line protocol over a text-file pair until EOF or
     ``quit``; returns the number of requests handled. The writer drains
     futures in submission order on its own thread so slow shards never
     stall ingestion (ingestion is bounded by the shard queues, which is
-    the point)."""
+    the point). ``families`` (a ``traffic.QueryFamilies``) enables the
+    typed mat/alt/rev sentences."""
     if result_timeout_s is None:
         result_timeout_s = frontend.sconf.deadline_s + 30.0
     pending: _stdqueue.Queue = _stdqueue.Queue()
@@ -71,6 +84,8 @@ def serve_stream(frontend: ServingFrontend, rfile, wfile,
     writer = threading.Thread(target=_write_loop, daemon=True,
                               name="dos-serve-writer")
     writer.start()
+    if families is not None:       # once, not per line on the hot loop
+        from ..traffic.families import parse_family_line
     try:
         for line in rfile:
             body = line.strip()
@@ -78,6 +93,28 @@ def serve_stream(frontend: ServingFrontend, rfile, wfile,
                 continue
             if body == QUIT_TOKEN:
                 break
+            if families is not None:
+                try:
+                    fam = parse_family_line(body)
+                except ValueError:
+                    pending.put(Future.completed(ServeResult(
+                        ERROR, -1, -1, detail="malformed-line")))
+                    continue
+                if fam is not None:
+                    try:
+                        fut = families.submit_line(*fam)
+                    except Exception as e:  # noqa: BLE001 — a bad
+                        # family request (out-of-range node, missing
+                        # graph) must answer in-order like a malformed
+                        # line, never kill the whole session
+                        detail = (str(e).split("\n")[0]
+                                  .replace(" ", "-") or "family-failed")
+                        pending.put(Future.completed(ServeResult(
+                            ERROR, -1, -1, detail=detail)))
+                        continue
+                    pending.put(fut)
+                    n += 1
+                    continue
             try:
                 s, t = parse_query_line(body)
             except ValueError:
@@ -92,14 +129,16 @@ def serve_stream(frontend: ServingFrontend, rfile, wfile,
     return n
 
 
-def serve_stdin(frontend: ServingFrontend) -> int:
+def serve_stdin(frontend: ServingFrontend, families=None) -> int:
     import sys
 
-    return serve_stream(frontend, sys.stdin, sys.stdout)
+    return serve_stream(frontend, sys.stdin, sys.stdout,
+                        families=families)
 
 
 def serve_unix_socket(frontend: ServingFrontend, path: str,
-                      stop: threading.Event | None = None) -> None:
+                      stop: threading.Event | None = None,
+                      families=None) -> None:
     """Accept loop on a unix stream socket; one ``serve_stream`` session
     per connection. Bounded accept timeout so ``stop`` (or KeyboardInterrupt)
     is honored promptly; connection threads are joined on exit."""
@@ -118,7 +157,8 @@ def serve_unix_socket(frontend: ServingFrontend, path: str,
             rfile = sock.makefile("r")
             wfile = sock.makefile("w")
             try:
-                serve_stream(frontend, rfile, wfile)
+                serve_stream(frontend, rfile, wfile,
+                             families=families)
             except Exception as e:  # noqa: BLE001 — one bad client
                 # must not kill the accept loop
                 log.warning("socket session failed: %s", e)
@@ -147,7 +187,7 @@ def serve_unix_socket(frontend: ServingFrontend, path: str,
 def tail_file(frontend: ServingFrontend, path: str,
               out_path: str | None = None,
               stop: threading.Event | None = None,
-              poll_s: float = 0.2) -> int:
+              poll_s: float = 0.2, families=None) -> int:
     """Follow ``path`` for appended request lines (the dead-simple
     ingress for batch producers that can only write files); responses
     append to ``<path>.answers``. A ``quit`` line ends the tail."""
@@ -180,5 +220,6 @@ def tail_file(frontend: ServingFrontend, path: str,
                             line += chunk
                     yield line
 
-            n = serve_stream(frontend, _lines(), wfile)
+            n = serve_stream(frontend, _lines(), wfile,
+                             families=families)
     return n

@@ -53,6 +53,16 @@ FAIL_LINE = "FAIL"
 #: epoch, so legacy deployments never meet it.
 STALE_EPOCH_LINE = "STALE_EPOCH"
 
+#: answer-FIFO sentinel for the live-traffic version gate: a worker
+#: whose DIFF epoch is OLDER than the request's
+#: ``RuntimeConfig.diff_epoch`` (and that stayed older after refreshing
+#: its segment stream) refuses the batch rather than read a fused diff
+#: file its filesystem view may not have yet. Same compat shape as
+#: ``STALE_EPOCH``: new heads read a failed row with ``stale_diff``
+#: set; the sentinel only appears when a new head stamped a nonzero
+#: diff epoch, so legacy deployments never meet it.
+STALE_DIFF_LINE = "STALE_DIFF"
+
 #: liveness control frame: ``__DOS_PING__ <answerfifo>`` as a single
 #: command-FIFO line asks the server to write one health JSON line
 #: (:class:`HealthStatus`) to the named FIFO — the wire half of
@@ -102,6 +112,26 @@ class RuntimeConfig:
     query's ``cost plen finished`` into ``<queryfile>.results`` next to
     the query file (the ``.paths`` sidecar pattern; stats CSV wire
     unchanged). Same compat contract as ``extract``/``trace_id``.
+
+    ``diff_epoch`` is the live-traffic wire extension (``traffic``):
+    the head stamps the DIFF epoch the batch's ``difffile`` was fused
+    at, exactly parallel to the membership ``epoch`` — a worker at a
+    NEWER diff epoch serves anyway (older fused files stay readable in
+    the spool window), a worker at an OLDER one refreshes its segment
+    stream and, if still older, refuses with the ``STALE_DIFF``
+    sentinel so the head fails over instead of the worker failing an
+    open() on a fused file its NFS view has not seen yet. ``0`` is the
+    static-diff world and never gates.
+
+    ``sig_k`` asks the engine for a bounded **path signature** next to
+    the answers: the first ``sig_k`` path nodes of each query,
+    materialized through the existing ``.paths`` sidecar — WITHOUT
+    touching the walk semantics (``k_moves`` still governs the move
+    budget; ``sig_k`` only adds the cheap extraction scan). The serving
+    cache keys scoped invalidation off these signatures. Same compat
+    contract: old servers filter the unknown key and simply ship no
+    sidecar, and the cache degrades to conservative (signature-less)
+    invalidation.
     """
 
     hscale: float = 1.0
@@ -118,6 +148,8 @@ class RuntimeConfig:
     trace_id: str = ""
     results: bool = False
     epoch: int = 0
+    diff_epoch: int = 0
+    sig_k: int = 0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -170,6 +202,10 @@ class StatsRow:
     #: table is OLDER than the request's epoch (the ``STALE_EPOCH``
     #: wire sentinel) — a routing-state failure, not an engine one
     stale_epoch: bool = False
+    #: head-side: the worker refused the batch because its DIFF epoch
+    #: is OLDER than the request's ``diff_epoch`` (the ``STALE_DIFF``
+    #: wire sentinel) — the traffic-plane twin of ``stale_epoch``
+    stale_diff: bool = False
 
     def encode(self) -> str:
         vals = [getattr(self, f) for f in ENGINE_STAT_FIELDS]
@@ -185,6 +221,8 @@ class StatsRow:
             # the head can tell a routing-state refusal from an engine
             # crash (failover treats both the same; operators do not)
             return cls(ok=False, stale_epoch=True)
+        if line.strip().startswith(STALE_DIFF_LINE):
+            return cls(ok=False, stale_diff=True)
         parts = line.strip().split(",")
         if len(parts) != len(ENGINE_STAT_FIELDS):
             raise ValueError(
@@ -209,6 +247,8 @@ class StatsRow:
         stale-epoch refusals carry their own sentinel)."""
         if self.stale_epoch:
             return STALE_EPOCH_LINE
+        if self.stale_diff:
+            return STALE_DIFF_LINE
         return FAIL_LINE if not self.ok else self.encode()
 
     def as_list(self, t_prepare: float = 0.0, t_partition: float = 0.0,

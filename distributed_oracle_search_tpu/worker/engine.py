@@ -27,6 +27,7 @@ import glob
 import os
 import re
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -38,6 +39,7 @@ from ..obs import quantiles as obs_quantiles
 from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
 from ..transport.wire import RuntimeConfig, StatsRow
+from ..utils.env import env_cast
 from ..utils.log import get_logger, set_worker_id
 
 log = get_logger(__name__)
@@ -185,7 +187,18 @@ class ShardEngine:
         else:
             self.fm = None
         self.dg = DeviceGraph.from_graph(graph)
-        self._weight_cache: dict[str, object] = {}
+        #: per-diff device weight buffers, LRU-bounded: the live-traffic
+        #: plane swaps fused diffs every few seconds, and an unbounded
+        #: cache would pin one HBM weights array per epoch forever. The
+        #: bound is >= 2 by construction — the DOUBLE BUFFER: when an
+        #: epoch swap lands, in-flight batches still pinned to the old
+        #: fused file finish on its resident buffer while new batches
+        #: warm the new one (raw host-side astar entries share the
+        #: budget; a re-upload after eviction is a read+transfer, never
+        #: a correctness event)
+        self._weight_cache: OrderedDict[object, object] = OrderedDict()
+        self._weight_keep = max(
+            2, env_cast("DOS_TRAFFIC_WEIGHT_EPOCHS", 4, int))
         #: (alg, qpad, knobs) keys whose program has already run once —
         #: the first call at a new key pays XLA compilation and is
         #: recorded to ``worker_jit_compile_seconds`` instead of the
@@ -202,6 +215,7 @@ class ShardEngine:
     def _weights_for(self, difffile: str, no_cache: bool):
         import jax.numpy as jnp
         if difffile in self._weight_cache and not no_cache:
+            self._weight_cache.move_to_end(difffile)
             return self._weight_cache[difffile]
         if difffile == "-":
             w_pad = self.dg.w_pad
@@ -212,7 +226,12 @@ class ShardEngine:
             self._weight_cache.clear()
         else:
             self._weight_cache[difffile] = w_pad
+            self._trim_weight_cache()
         return w_pad
+
+    def _trim_weight_cache(self) -> None:
+        while len(self._weight_cache) > self._weight_keep:
+            self._weight_cache.popitem(last=False)
 
     # -------------------------------------------------------------- batch
     def answer(self, queries: np.ndarray, config: RuntimeConfig,
@@ -252,6 +271,10 @@ class ShardEngine:
             if config.extract and config.k_moves > 0:
                 self.last_paths = (
                     np.zeros((0, config.k_moves + 1), np.int64),
+                    np.zeros(0, np.int64))
+            elif config.sig_k > 0:
+                self.last_paths = (
+                    np.zeros((0, config.sig_k + 1), np.int64),
                     np.zeros(0, np.int64))
             return (np.zeros(0, np.int64), np.zeros(0, np.int64),
                     np.zeros(0, bool), StatsRow())
@@ -308,11 +331,14 @@ class ShardEngine:
             jit_key = ("astar", min(qpad, self.astar_chunk))
         else:
             if (config.time and qpad > self.astar_chunk
-                    and not extracting):
+                    and not extracting and config.sig_k <= 0):
+                # sig extraction (like extract) runs at the full qpad,
+                # so its compile must stay attributable to this key
                 shape_key = self.astar_chunk
             else:
                 shape_key = qpad
-            jit_key = (self.alg, shape_key, config.k_moves, extracting)
+            jit_key = (self.alg, shape_key, config.k_moves, extracting,
+                       config.sig_k if config.sig_k > 0 else 0)
         first_call = jit_key not in self._jit_seen
         if self.alg == "astar":
             deadline = t1 + config.time / 1e9 if config.time else None
@@ -380,6 +406,20 @@ class ShardEngine:
             nodes, moves = extract_paths(
                 self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
                 jnp.asarray(t), k=config.k_moves)
+            nodes = np.asarray(nodes[:nu], np.int64)[unsort]
+            moves = np.asarray(moves[:nu], np.int64)[unsort]
+            if inverse is not None:
+                nodes, moves = nodes[inverse], moves[inverse]
+            self.last_paths = (nodes, moves)
+        elif config.sig_k > 0:
+            # bounded path SIGNATURE for the serving cache's scoped
+            # invalidation (RuntimeConfig.sig_k wire extension): the
+            # same extraction scan as --extract but decoupled from
+            # k_moves, so the walk's move budget — and therefore every
+            # answer — is untouched
+            nodes, moves = extract_paths(
+                self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
+                jnp.asarray(t), k=int(config.sig_k))
             nodes = np.asarray(nodes[:nu], np.int64)[unsort]
             moves = np.asarray(moves[:nu], np.int64)[unsort]
             if inverse is not None:
@@ -455,6 +495,7 @@ class ShardEngine:
 
         key = ("raw", difffile)
         if key in self._weight_cache and not no_cache:
+            self._weight_cache.move_to_end(key)
             return self._weight_cache[key]
         w = (self.graph.w if difffile == "-"
              else self.graph.weights_with_diff(read_diff(difffile)))
@@ -463,6 +504,7 @@ class ShardEngine:
             self._weight_cache.pop(key, None)
         else:
             self._weight_cache[key] = entry
+            self._trim_weight_cache()
         return entry
 
     def _answer_astar(self, queries: np.ndarray, config: RuntimeConfig,

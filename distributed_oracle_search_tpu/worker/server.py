@@ -81,17 +81,36 @@ M_STALE_EPOCH = obs_metrics.counter(
     "batches refused with STALE_EPOCH: the request was routed under a "
     "NEWER partition-table epoch than this worker has, even after a "
     "membership refresh")
+M_STALE_DIFF = obs_metrics.counter(
+    "server_stale_diff_total",
+    "batches refused with STALE_DIFF: the request named a fused diff "
+    "from a NEWER traffic epoch than this worker's segment stream "
+    "shows, even after a refresh")
 
 
 class FifoServer:
     def __init__(self, conf: ClusterConfig, wid: int,
                  command_fifo: str | None = None,
-                 alg: str = "table-search"):
+                 alg: str = "table-search",
+                 traffic_dir: str | None = None):
         from ..parallel import membership
 
         self.conf = conf
         self.wid = wid
         self.alg = alg
+        #: live-traffic gate (``--traffic-dir``): a gate-only epoch
+        #: manager over the shared segment stream — it never
+        #: materializes fused files (the head did), it only tracks the
+        #: stream's epoch so a request stamped with a NEWER diff epoch
+        #: triggers a refresh-then-refuse instead of a failed open() on
+        #: a fused file this worker's NFS view has not seen yet
+        self.traffic = None
+        if traffic_dir:
+            from ..traffic import DiffEpochManager
+
+            self.traffic = DiffEpochManager(traffic_dir,
+                                            materialize=False)
+            self.traffic.refresh()
         self.command_fifo = command_fifo or command_fifo_path(wid)
         self.graph = Graph.from_xy(conf.xy_file)
         self.dc = DistributionController(
@@ -337,7 +356,8 @@ class FifoServer:
                     M_MALFORMED.inc()
                     self._answer_malformed(text)
                     continue
-                stale = self._epoch_gate(req.config)
+                stale = (self._epoch_gate(req.config)
+                         or self._traffic_gate(req.config))
                 if stale is not None:
                     # version-gated refusal: the head routed this batch
                     # under a NEWER partition table than we can see —
@@ -554,6 +574,34 @@ class FifoServer:
                     self.wid, getattr(self, "epoch", 0), req_epoch)
         return StatsRow(ok=False, stale_epoch=True)
 
+    def _traffic_gate(self, config) -> StatsRow | None:
+        """The tolerate-older / gate-newer rule applied to the DIFF
+        epoch (``RuntimeConfig.diff_epoch`` wire extension): a request
+        fused at a NEWER traffic epoch than our segment stream shows
+        first refreshes the stream (the segment may simply not have
+        been polled yet — the normal case right after a swap), and only
+        if we are STILL older refuses with the ``STALE_DIFF`` sentinel
+        so the head fails over instead of this worker failing an open()
+        on a not-yet-visible fused file. Requests from older diff
+        epochs are always served (the spool's keep window holds their
+        files). Workers without ``--traffic-dir`` never gate — the
+        difffile on the wire is a concrete path they can read or fail
+        loudly on."""
+        traffic = getattr(self, "traffic", None)
+        if traffic is None:
+            return None
+        req_depoch = int(getattr(config, "diff_epoch", 0) or 0)
+        if req_depoch <= traffic.epoch:
+            return None
+        traffic.refresh()
+        if req_depoch <= traffic.epoch:
+            return None
+        M_STALE_DIFF.inc()
+        log.warning("worker %d at diff epoch %d refusing batch from "
+                    "diff epoch %d (segment stream has no newer "
+                    "segment)", self.wid, traffic.epoch, req_depoch)
+        return StatsRow(ok=False, stale_diff=True)
+
     def _refresh_membership(self) -> None:
         """Re-read the durable membership state (epoch + owners +
         in-flight migration) and swap in a controller reflecting it.
@@ -629,6 +677,12 @@ class FifoServer:
         # a pre-elastic worker simply omits both keys, and consumers
         # (`dos-obs top`) render blanks for a missing key, never crash
         out["epoch"] = int(getattr(self, "epoch", 0))
+        # live-traffic column: present only when this worker gates the
+        # diff stream (`dos-obs top` renders a blank otherwise — the
+        # same mixed-schema tolerance as the membership columns)
+        traffic = getattr(self, "traffic", None)
+        if traffic is not None:
+            out["diff_epoch"] = int(traffic.epoch)
         state = getattr(self, "_membership_state", None)
         if state is not None and state.migration is not None:
             out["migration"] = dict(state.migration)
@@ -703,13 +757,17 @@ def main(argv=None) -> int:
                    help="serve live /metrics /healthz /statusz on this "
                         "port (0 = ephemeral; default off; "
                         "DOS_OBS_PORT)")
+    p.add_argument("--traffic-dir", default=None,
+                   help="diff segment stream directory: gate requests "
+                        "whose diff epoch is newer than the stream "
+                        "shows (STALE_DIFF wire sentinel)")
     args = p.parse_args(argv)
     set_verbosity(args.verbose)
     set_worker_id(args.workerid)
 
     conf = ClusterConfig.load(args.c)
     server = FifoServer(conf, args.workerid, command_fifo=args.fifo,
-                        alg=args.alg)
+                        alg=args.alg, traffic_dir=args.traffic_dir)
     from ..obs.http import start_obs_server
     obs_srv = start_obs_server(
         args.obs_port, health_fn=server.health,

@@ -92,12 +92,16 @@ class WorkerSupervisor:
                  backoff_cap_s: float | None = None,
                  unhealthy_pings: int | None = None,
                  probe_timeout_s: float = 10.0,
-                 spawn_fn=None, probe_fn=None):
+                 spawn_fn=None, probe_fn=None,
+                 traffic_dir: str | None = None):
         self.conf = conf
         self.conf_path = conf_path
         self.alg = alg
         self.fifo_dir = fifo_dir
         self.logdir = logdir
+        #: diff segment stream for the spawned servers' STALE_DIFF
+        #: gate (None = workers never gate on diff epochs)
+        self.traffic_dir = traffic_dir
         self.ping_interval_s = (
             ping_interval_s if ping_interval_s is not None
             else env_cast("DOS_SUPERVISOR_PING_S", 2.0, float))
@@ -134,6 +138,8 @@ class WorkerSupervisor:
                "distributed_oracle_search_tpu.worker.server",
                "-c", self.conf_path, "--workerid", str(w.wid),
                "--fifo", w.fifo, "--alg", self.alg]
+        if self.traffic_dir:
+            cmd += ["--traffic-dir", self.traffic_dir]
         out = subprocess.DEVNULL
         if self.logdir:
             os.makedirs(self.logdir, exist_ok=True)
@@ -454,14 +460,16 @@ class WorkerSupervisor:
 def supervise_forever(conf: ClusterConfig, conf_path: str,
                       alg: str = "table-search",
                       logdir: str | None = None,
-                      obs_port: int | None = None) -> int:
+                      obs_port: int | None = None,
+                      traffic_dir: str | None = None) -> int:
     """``make_fifos --supervise`` entry: run until interrupted.
     ``obs_port`` (or ``DOS_OBS_PORT``) additionally serves live
     ``/metrics`` ``/healthz`` ``/statusz`` for the whole supervised
     fleet — healthz goes 503 the moment any worker is down."""
     from ..obs.http import start_obs_server
 
-    sup = WorkerSupervisor(conf, conf_path, alg=alg, logdir=logdir)
+    sup = WorkerSupervisor(conf, conf_path, alg=alg, logdir=logdir,
+                           traffic_dir=traffic_dir)
     obs_srv = None
     try:
         sup.start()

@@ -1,0 +1,924 @@
+"""Live traffic plane: segment codec compat, fused epoch swaps, scoped
+cache invalidation, query families, and the live-swap serve smoke.
+
+The tier-1 acceptance gate is ``test_live_swap_smoke``: a serving
+frontend answers 100+ mixed-family queries across one LIVE diff epoch
+swap with zero sheds, and every post-swap answer is bit-identical to a
+frontend started fresh on the swapped fused diff. The rush-hour replay
+drill (multiple epochs, answers pinned vs the CPU reference per epoch)
+stays behind ``slow``.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import ensure_synth_dataset, read_scen
+from distributed_oracle_search_tpu.data.formats import read_diff, write_diff
+from distributed_oracle_search_tpu.data.graph import Graph
+from distributed_oracle_search_tpu.models.cpd import write_index_manifest
+from distributed_oracle_search_tpu.models.reference import (
+    first_move_to_target, table_search_walk,
+)
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.serving import (
+    CallableDispatcher, EngineDispatcher, ResultCache, ServeConfig,
+    ServingFrontend,
+)
+from distributed_oracle_search_tpu.serving import ingress
+from distributed_oracle_search_tpu.traffic import (
+    DiffEpochManager, DiffSegment, DiffStream, QueryFamilies,
+    SEGMENT_SCHEMA, TailDiffStream, list_segments, parse_family_line,
+    read_segment, segment_path, write_segment,
+)
+from distributed_oracle_search_tpu.traffic import scenarios
+from distributed_oracle_search_tpu.traffic.segments import encode_segment
+from distributed_oracle_search_tpu.transport.wire import (
+    RuntimeConfig, STALE_DIFF_LINE, StatsRow,
+)
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker.build import main as build_main
+from distributed_oracle_search_tpu.worker.server import FifoServer
+
+pytestmark = pytest.mark.traffic
+
+
+def _counter(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot()["counters"].get(name, 0)
+
+
+# ------------------------------------------------------------- fixtures
+
+@pytest.fixture(scope="module")
+def traffic_world(tmp_path_factory):
+    """Small 2-shard world with a built CPD index (the test_serving
+    pattern): graph, controller, conf, scenario queries."""
+    datadir = str(tmp_path_factory.mktemp("traffic-data"))
+    paths = ensure_synth_dataset(datadir, width=10, height=8,
+                                 n_queries=96, seed=33)
+    conf = ClusterConfig(
+        workers=["localhost", "localhost"],
+        partmethod="mod", partkey=2,
+        outdir=os.path.join(datadir, "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"],
+        diffs=["-", paths["diff"]],
+        nfs=datadir,
+    ).validate()
+    for wid in range(conf.maxworker):
+        build_main(["--input", conf.xy_file, "--partmethod",
+                    conf.partmethod, "--partkey", str(conf.partkey),
+                    "--workerid", str(wid),
+                    "--maxworker", str(conf.maxworker),
+                    "--outdir", conf.outdir])
+    g = Graph.from_xy(conf.xy_file)
+    dc = DistributionController(conf.partmethod, conf.partkey,
+                                conf.maxworker, g.n)
+    write_index_manifest(conf.outdir, dc)
+    queries = read_scen(conf.scenfile)
+    dispatcher = EngineDispatcher(conf, graph=g, dc=dc)
+    return conf, g, dc, queries, dispatcher
+
+
+def _reference_answers(g, queries, w_query):
+    """CPU-oracle golden triple for (s, t) pairs under query weights."""
+    fm_cache = {}
+
+    def fm_of(x, t):
+        if t not in fm_cache:
+            fm_cache[t] = first_move_to_target(g, int(t))
+        return fm_cache[t][int(x)]
+
+    cost = np.zeros(len(queries), np.int64)
+    plen = np.zeros(len(queries), np.int64)
+    fin = np.zeros(len(queries), bool)
+    for i, (s, t) in enumerate(queries):
+        c, p, f, _path = table_search_walk(g, fm_of, int(s), int(t),
+                                           w_query=w_query)
+        cost[i], plen[i], fin[i] = c, p, f
+    return cost, plen, fin
+
+
+# ------------------------------------------- satellite: codec compat
+
+def test_segment_roundtrip(tmp_path):
+    d = str(tmp_path)
+    p = write_segment(d, 3, [0, 1], [1, 2], [50, 60])
+    assert p == segment_path(d, 3)
+    seg = read_segment(p)
+    assert seg.epoch == 3 and len(seg) == 2
+    assert seg.pairs() == [(0, 1), (1, 2)]
+    assert list(seg.w) == [50, 60]
+
+
+def test_segment_unknown_keys_tolerated(tmp_path):
+    d = str(tmp_path)
+    write_segment(d, 1, [0], [1], [9],
+                  extra={"producer": "sensor-fleet", "region": 7})
+    seg = read_segment(segment_path(d, 1))
+    assert seg.epoch == 1 and list(seg.w) == [9]
+
+
+def test_segment_newer_schema_rejected(tmp_path):
+    d = str(tmp_path)
+    raw = encode_segment(1, [0], [1], [9]).decode()
+    header = json.loads(raw.split("\n")[0])
+    header["schema"] = SEGMENT_SCHEMA + 1
+    body = "\n".join([json.dumps(header)] + raw.split("\n")[1:])
+    p = segment_path(d, 1)
+    os.makedirs(d, exist_ok=True)
+    with open(p, "w") as f:
+        f.write(body)
+    with pytest.raises(ValueError, match="newer"):
+        read_segment(p)
+
+
+def test_segment_torn_tail_ignored(tmp_path):
+    d = str(tmp_path)
+    write_segment(d, 1, [0], [1], [9])
+    # a torn TAIL (non-atomic producer mid-write) is skipped...
+    with open(segment_path(d, 2), "w") as f:
+        f.write(json.dumps({"kind": "dos-traffic-segment", "schema": 1,
+                            "epoch": 2, "entries": 3}) + "\n0 1 5\n")
+    segs = list_segments(d)
+    assert [s.epoch for s in segs] == [1]
+    # ...but a torn MID-stream segment is data loss and raises
+    write_segment(d, 3, [2], [3], [7])
+    with pytest.raises(ValueError, match="mid-stream"):
+        list_segments(d)
+
+
+def test_segment_filename_epoch_mismatch(tmp_path):
+    d = str(tmp_path)
+    write_segment(d, 1, [0], [1], [9])
+    os.rename(segment_path(d, 1), segment_path(d, 4))
+    with pytest.raises(ValueError, match="header says"):
+        read_segment(segment_path(d, 4))
+
+
+def test_tail_stream_torn_frame(tmp_path):
+    spool = str(tmp_path / "spool.segs")
+    ts = TailDiffStream(spool)
+    assert ts.poll() == []                    # producer not started
+    ts.append(encode_segment(1, [0], [1], [9]))
+    ts.append(encode_segment(2, [1], [2], [8])[:-8])   # torn tail
+    got = ts.poll()
+    assert [s.epoch for s in got] == [1]
+    with open(spool, "ab") as f:              # rest of frame 2 lands
+        f.write(encode_segment(2, [1], [2], [8])[-8:])
+    got = ts.poll()
+    assert [s.epoch for s in got] == [2]
+    assert list(got[0].w) == [8]
+
+
+def test_tail_stream_multibyte_header_annotation(tmp_path):
+    """Regression pin: the resume offset counts BYTES. A third-party
+    producer may annotate headers with raw UTF-8 (our own encoder
+    escapes, but the contract tolerates unknown keys as the producer
+    wrote them); a multi-byte annotation used to desync the
+    char-counted offset from the byte seek and stall the stream on the
+    next frame."""
+    spool = str(tmp_path / "spool.segs")
+    ts = TailDiffStream(spool)
+    raw = json.dumps({"kind": "dos-traffic-segment", "schema": 1,
+                      "epoch": 1, "entries": 1,
+                      "corridor": "Åsgatan–Brogränd"},
+                     ensure_ascii=False)
+    ts.append((raw + "\n0 1 9\n").encode())
+    assert [s.epoch for s in ts.poll()] == [1]
+    ts.append(encode_segment(2, [1], [2], [8]))
+    got = ts.poll()
+    assert [s.epoch for s in got] == [2]
+    assert list(got[0].w) == [8]
+
+
+def test_stream_holds_back_out_of_order_visibility(tmp_path):
+    """Regression pin: on a shared filesystem a higher-numbered
+    segment can become visible before a lower one; skipping the gap
+    would omit the late segment's retimes from every later fusion
+    forever. Held back until the gap fills; a late joiner still syncs
+    to wherever the stream is."""
+    d = str(tmp_path)
+    ds = DiffStream(d)
+    write_segment(d, 1, [0], [1], [9])
+    assert [s.epoch for s in ds.poll()] == [1]
+    write_segment(d, 3, [2], [3], [7])       # 3 visible before 2
+    assert ds.poll() == []                   # held back
+    write_segment(d, 2, [1], [2], [8])       # the gap fills
+    assert [s.epoch for s in ds.poll()] == [2, 3]
+    late = DiffStream(d)                     # late joiner: any start
+    assert [s.epoch for s in late.poll()] == [1, 2, 3]
+
+
+# ------------------------------------------------- epoch manager
+
+def test_fused_multi_segment_swap(tmp_path):
+    d = str(tmp_path / "stream")
+    m = DiffEpochManager(d, keep_epochs=2)
+    assert m.epoch == 0 and not m.refresh()
+    write_segment(d, 1, [0, 1], [1, 2], [50, 60])
+    write_segment(d, 2, [0, 5], [1, 6], [50, 70])   # (0,1) re-stated
+    assert m.refresh()                   # BOTH segments fuse into one
+    epoch, difffile, affected = m.active()
+    assert epoch == 2
+    # (0,1)=50 twice: changed once vs free flow; (1,2) and (5,6) new
+    assert affected == {(0, 1), (1, 2), (5, 6)}
+    src, dst, w = read_diff(difffile)
+    fused = {(int(u), int(v)): int(ww) for u, v, ww in zip(src, dst, w)}
+    assert fused == {(0, 1): 50, (1, 2): 60, (5, 6): 70}
+    # a segment re-stating an ACTIVE weight affects nothing
+    write_segment(d, 3, [0], [1], [50])
+    assert m.refresh()
+    _, _, affected = m.active()
+    assert affected == set()
+    # spool pruning keeps the keep window (>= 2: double buffer)
+    write_segment(d, 4, [0], [1], [55])
+    assert m.refresh()
+    import glob as _glob
+    kept = sorted(_glob.glob(os.path.join(m.spool, "fused-e*.diff")))
+    assert len(kept) == 2
+    assert kept[-1] == m.fused_path(4)
+
+
+def test_refresh_retains_segments_when_materialize_fails(tmp_path):
+    """Regression pin: the stream cursor advances inside poll(), so a
+    failed fused-diff write must keep the polled segments pending — a
+    drop would silently omit their retimes from every later epoch."""
+    d = str(tmp_path / "stream")
+    blocked = tmp_path / "spool"
+    blocked.write_text("a FILE where the spool dir should be")
+    m = DiffEpochManager(d, spool_dir=str(blocked))
+    write_segment(d, 1, [0], [1], [9])
+    assert not m.refresh()                   # makedirs fails: no swap
+    assert m.epoch == 0 and m.weight_of(0, 1, 5) == 5
+    os.remove(blocked)                       # the operator clears it
+    assert m.refresh()                       # pending segments retried
+    assert m.epoch == 1 and m.weight_of(0, 1, 5) == 9
+
+
+def test_manager_base_diff_and_weight_of(tmp_path):
+    base = str(tmp_path / "base.diff")
+    write_diff(base, np.asarray([7]), np.asarray([8]),
+               np.asarray([123]))
+    d = str(tmp_path / "stream")
+    m = DiffEpochManager(d, base_diff=base)
+    assert m.weight_of(7, 8, 999) == 123        # base diff applies
+    assert m.weight_of(1, 2, 42) == 42          # free-flow fallback
+    write_segment(d, 1, [7], [8], [200])
+    assert m.refresh()
+    assert m.weight_of(7, 8, 999) == 200        # segment wins
+    src, dst, w = read_diff(m.difffile)
+    assert {(int(u), int(v)): int(ww)
+            for u, v, ww in zip(src, dst, w)} == {(7, 8): 200}
+
+
+# ------------------------------------- scoped cache invalidation
+
+def _key(s, t, diff="-", fp=(), mep=0, dep=0):
+    return (s, t, diff, fp, mep, dep)
+
+
+def test_scoped_invalidation_rekeys_survivors():
+    cache = ResultCache(1 << 20)
+    # entry A: path avoids the updated edge; B: touches it; C: no sig
+    cache.put(_key(1, 2), (10, 2, True), sig=frozenset({1, 5, 2}))
+    cache.put(_key(3, 4), (20, 3, True), sig=frozenset({3, 8, 9, 4}))
+    cache.put(_key(5, 6), (30, 4, True))            # signature-less
+    s0 = _counter("serve_cache_invalidated_scoped_total")
+    dropped, kept, reason = cache.invalidate_scoped(
+        {(8, 9)}, "fused.diff", 1, max_edges=100,
+        old_diff="-", old_depoch=0)
+    assert (dropped, kept, reason) == (2, 1, "scoped")
+    assert _counter("serve_cache_invalidated_scoped_total") - s0 == 2
+    # the survivor was RE-KEYED to the new (diff, diff epoch): post-swap
+    # traffic keeps hitting it, the old key is gone
+    assert cache.get(_key(1, 2, "fused.diff", dep=1)) == (10, 2, True)
+    assert cache.get(_key(1, 2)) is None
+    assert cache.get(_key(3, 4, "fused.diff", dep=1)) is None
+
+
+def test_scoped_invalidation_edge_midpath():
+    # both endpoints on the path but NOT consecutive: conservative drop
+    # is allowed; an entry whose nodes miss an endpoint must survive
+    cache = ResultCache(1 << 20)
+    cache.put(_key(1, 4), (5, 3, True), sig=frozenset({1, 2, 3, 4}))
+    dropped, kept, _ = cache.invalidate_scoped(
+        {(9, 2)}, "f.diff", 1, max_edges=100,
+        old_diff="-", old_depoch=0)             # 9 not on the path
+    assert (dropped, kept) == (0, 1)
+
+
+def test_scoped_invalidation_drops_other_epoch_entries():
+    """Regression pin: an entry cached under an OLDER epoch (a late
+    put from a batch in flight across the previous swap) was never
+    tested against the intermediate deltas — re-keying it on a later
+    swap could resurrect a stale cost. Only entries keyed at exactly
+    the fusion the swap replaced may survive."""
+    cache = ResultCache(1 << 20)
+    # late put: computed at epoch 0 while epoch 1 is already active;
+    # its path DOES touch the edge the 0->1 swap updated
+    cache.put(_key(1, 2, dep=0), (10, 2, True),
+              sig=frozenset({1, 7, 2}))
+    # a current-epoch entry, clean of the 1->2 delta
+    cache.put(_key(3, 4, "f1.diff", dep=1), (20, 2, True),
+              sig=frozenset({3, 9, 4}))
+    # swap 1 -> 2 updates (5, 6): disjoint from BOTH signatures, but
+    # only the epoch-1 entry is eligible to survive
+    dropped, kept, reason = cache.invalidate_scoped(
+        {(5, 6)}, "f2.diff", 2, max_edges=100,
+        old_diff="f1.diff", old_depoch=1)
+    assert (dropped, kept, reason) == (1, 1, "scoped")
+    assert cache.get(_key(3, 4, "f2.diff", dep=2)) == (20, 2, True)
+    assert cache.get(_key(1, 2, "f2.diff", dep=2)) is None
+
+
+def test_scoped_full_flush_threshold():
+    cache = ResultCache(1 << 20)
+    for i in range(4):
+        cache.put(_key(i, i + 1), (i, 1, True), sig=frozenset({i}))
+    f0 = _counter("serve_cache_invalidated_full_total")
+    dropped, kept, reason = cache.invalidate_scoped(
+        {(i, i + 1) for i in range(10)}, "f.diff", 1, max_edges=5,
+        old_diff="-", old_depoch=0)
+    assert (dropped, kept, reason) == (4, 0, "full")
+    assert _counter("serve_cache_invalidated_full_total") - f0 == 4
+    assert len(cache) == 0
+
+
+# -------------------------- satellite: epochs folded into the key
+
+class _FakeMembership:
+    def __init__(self):
+        self.epoch = 0
+
+    def candidates_for(self, wid):
+        return [wid]
+
+    def statusz(self):
+        return {"epoch": self.epoch}
+
+
+def test_cache_key_includes_membership_epoch():
+    """Regression pin (PR 9 satellite): a post-reshard cache hit used
+    to serve a result computed by a worker that no longer owns the
+    shard — the membership epoch is now part of the key, so an epoch
+    bump turns the stale entry into a miss."""
+    dc = DistributionController("mod", 2, 2, 100)
+    mem = _FakeMembership()
+    calls = []
+
+    def answer(wid, q, rconf, diff):
+        calls.append(len(q))
+        n = len(q)
+        return (np.full(n, 7), np.ones(n, np.int64),
+                np.ones(n, bool))
+
+    fe = ServingFrontend(
+        dc, CallableDispatcher(answer),
+        sconf=ServeConfig(max_wait_ms=1.0).validate(),
+        membership=mem)
+    fe.start()
+    try:
+        assert fe.query(1, 2).ok
+        r2 = fe.query(1, 2)
+        assert r2.ok and r2.cached            # same epoch: cache hit
+        mem.epoch = 1                          # reshard commits
+        r3 = fe.query(1, 2)
+        assert r3.ok and not r3.cached        # MISS: key re-derived
+        assert len(calls) == 2
+    finally:
+        fe.stop()
+
+
+def test_cache_key_includes_diff_epoch():
+    dc = DistributionController("mod", 2, 2, 100)
+
+    def answer(wid, q, rconf, diff):
+        n = len(q)
+        return (np.full(n, 7), np.ones(n, np.int64), np.ones(n, bool))
+
+    fe = ServingFrontend(dc, CallableDispatcher(answer),
+                         sconf=ServeConfig(max_wait_ms=1.0).validate())
+    fe.start()
+    try:
+        assert fe.query(1, 2).ok
+        assert fe.query(1, 2).cached
+        fe._diff_epoch = 3                    # an epoch swap landed
+        assert not fe.query(1, 2).cached
+    finally:
+        fe.stop()
+
+
+# ----------------------------------------------------- wire compat
+
+def test_diff_epoch_wire_roundtrip():
+    rc = RuntimeConfig(diff_epoch=4, sig_k=32)
+    back = RuntimeConfig.from_json(rc.to_json())
+    assert back.diff_epoch == 4 and back.sig_k == 32
+    # old peer's json (no new keys) -> defaults; unknown keys filtered
+    legacy = json.dumps({"hscale": 1.0, "future_knob": 9})
+    rc2 = RuntimeConfig.from_json(legacy)
+    assert rc2.diff_epoch == 0 and rc2.sig_k == 0
+
+
+def test_stale_diff_sentinel_roundtrip():
+    row = StatsRow(ok=False, stale_diff=True)
+    assert row.encode_wire() == STALE_DIFF_LINE
+    back = StatsRow.decode(STALE_DIFF_LINE)
+    assert not back.ok and back.stale_diff and not back.stale_epoch
+    # a stale-EPOCH line still decodes to the membership flag only
+    other = StatsRow.decode("STALE_EPOCH")
+    assert other.stale_epoch and not other.stale_diff
+
+
+def test_server_stale_diff_gate(tmp_path):
+    d = str(tmp_path / "stream")
+    srv = FifoServer.__new__(FifoServer)
+    srv.wid = 0
+    srv.traffic = DiffEpochManager(d, materialize=False)
+    s0 = _counter("server_stale_diff_total")
+    # older and equal diff epochs always serve
+    assert srv._traffic_gate(RuntimeConfig()) is None
+    assert srv._traffic_gate(RuntimeConfig(diff_epoch=0)) is None
+    # newer than the stream shows, even after refresh: refuse
+    row = srv._traffic_gate(RuntimeConfig(diff_epoch=5))
+    assert row is not None and row.stale_diff and not row.ok
+    assert _counter("server_stale_diff_total") - s0 == 1
+    # the segment lands: the refresh inside the gate now sees it
+    write_segment(d, 5, [0], [1], [9])
+    assert srv._traffic_gate(RuntimeConfig(diff_epoch=5)) is None
+    # a worker with no traffic manager never gates
+    srv.traffic = None
+    assert srv._traffic_gate(RuntimeConfig(diff_epoch=99)) is None
+
+
+# --------------------------------------------------- query families
+
+def test_family_line_parsing():
+    assert parse_family_line("3 5") is None
+    assert parse_family_line("mat 3 5 7 9") == ("mat", (3, [5, 7, 9]))
+    assert parse_family_line("alt 3 5 2") == ("alt", (3, 5, 2))
+    assert parse_family_line("rev 3 5") == ("rev", (3, 5))
+    for bad in ("mat 3", "alt 3 5", "rev 3", "alt 3 5 2 9"):
+        with pytest.raises(ValueError):
+            parse_family_line(bad)
+
+
+def test_families_match_reference(traffic_world):
+    conf, g, dc, queries, dispatcher = traffic_world
+    fe = ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(queue_depth=1024, max_wait_ms=1.0,
+                          cache_bytes=0).validate())
+    fam = QueryFamilies(fe, graph=g)
+    fe.start()
+    try:
+        s, t = int(queries[0][0]), int(queries[0][1])
+        targets = [int(q[1]) for q in queries[:8]]
+        # --- matrix: one cost per target, pinned element-wise
+        mat = fam.matrix(s, targets).result(60)
+        exp_c, _p, exp_f = _reference_answers(
+            g, [(s, tt) for tt in targets], g.w)
+        assert mat.encode().startswith(f"MAT {s} {len(targets)} ")
+        for c, ec, ef in zip(mat.costs, exp_c, exp_f):
+            assert c == (int(ec) if ef else -1)
+        # --- alternatives: distinct first edges, ranked by total cost
+        k = 3
+        alt = fam.alternatives(s, t, k).result(60)
+        nbrs, eids = g.out_edges(s)
+        exp = []
+        for v, e in zip(nbrs, eids):
+            c, _pl, f = _reference_answers(g, [(int(v), t)], g.w)
+            if f[0]:
+                exp.append(int(g.w[e]) + int(c[0]))
+        exp.sort()
+        assert [c for c, _v in alt.alternatives] == exp[:k]
+        # the best alternative IS the optimal route
+        best, _pl, bf = _reference_answers(g, [(s, t)], g.w)
+        assert bf[0] and alt.alternatives[0][0] == int(best[0])
+        # --- reverse: the return trip, source-owner routed
+        rev = fam.reverse(s, t).result(60)
+        rc, rp, rf = _reference_answers(g, [(t, s)], g.w)
+        assert rev.encode() == (
+            f"REV {s} {t} {int(rc[0])} {int(rp[0])} {int(rf[0])}")
+        m0 = (_counter("serve_matrix_requests_total"),
+              _counter("serve_alt_requests_total"),
+              _counter("serve_reverse_requests_total"))
+        assert all(v >= 1 for v in m0)
+    finally:
+        fe.stop()
+
+
+def test_alt_rejects_out_of_range_nodes(traffic_world):
+    """Regression pin: ``alt`` indexes the graph before any submit —
+    an out-of-range source used to crash the ingress session and a
+    NEGATIVE source silently wrapped to another node's edges."""
+    conf, g, dc, queries, dispatcher = traffic_world
+    fe = ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(queue_depth=64, max_wait_ms=1.0,
+                          cache_bytes=0).validate())
+    fam = QueryFamilies(fe, graph=g)
+    for s, t in ((g.n + 7, 0), (-1, 0), (0, g.n), (0, -2)):
+        with pytest.raises(ValueError, match="node-out-of-range"):
+            fam.alternatives(s, t, 2)
+
+
+def test_family_ingress_survives_bad_family_request(traffic_world):
+    """A failing family submit answers ERROR in-order; the session
+    keeps serving the lines after it."""
+    import io
+
+    conf, g, dc, queries, dispatcher = traffic_world
+    fe = ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(queue_depth=1024, max_wait_ms=1.0,
+                          cache_bytes=0).validate())
+    fam = QueryFamilies(fe, graph=g)
+    fe.start()
+    try:
+        s, t = int(queries[0][0]), int(queries[0][1])
+        lines = (f"alt {g.n + 99} {t} 2\nalt -1 {t} 2\n"
+                 f"{s} {t}\nquit\n")
+        out = io.StringIO()
+        n = ingress.serve_stream(fe, io.StringIO(lines), out,
+                                 families=fam)
+        assert n == 1                      # only the pair counted
+        got = out.getvalue().strip().split("\n")
+        assert got[0].startswith("ERROR -1 -1 node-out-of-range")
+        assert got[1].startswith("ERROR -1 -1 node-out-of-range")
+        assert got[2].startswith(f"OK {s} {t} ")
+    finally:
+        fe.stop()
+
+
+def test_cache_budget_charges_signatures():
+    """Byte accounting: a signature-carrying entry costs its real
+    size, so a budget that holds N signature-less entries holds FEWER
+    once signatures ride along (the budget used to be a flat per-entry
+    guess the signatures blew through)."""
+    from distributed_oracle_search_tpu.serving.cache import (
+        ENTRY_BYTES, SIG_NODE_BYTES,
+    )
+
+    budget = 4 * ENTRY_BYTES
+    plain = ResultCache(budget)
+    for i in range(4):
+        plain.put(_key(i, i + 1), (i, 1, True))
+    assert len(plain) == 4                 # flat entries: all fit
+    sigged = ResultCache(budget)
+    big = frozenset(range(ENTRY_BYTES // SIG_NODE_BYTES))  # 1 entry's
+    for i in range(4):                     # worth of signature each
+        sigged.put(_key(i, i + 1), (i, 1, True), sig=big)
+    assert len(sigged) == 2                # charged 2x: half fit
+
+
+def test_cache_refresh_with_signature_evicts():
+    """Regression pin: attaching a signature to an EXISTING entry
+    grows the footprint too — the refresh path must run the same
+    eviction loop, or a stable hot pool re-answering with signatures
+    pins far past the byte budget with no new key ever evicting."""
+    from distributed_oracle_search_tpu.serving.cache import (
+        ENTRY_BYTES, SIG_NODE_BYTES,
+    )
+
+    budget = 4 * ENTRY_BYTES
+    cache = ResultCache(budget)
+    for i in range(4):
+        cache.put(_key(i, i + 1), (i, 1, True))    # at budget, sig-less
+    big = frozenset(range(2 * ENTRY_BYTES // SIG_NODE_BYTES))
+    for i in range(4):                             # re-answer with sigs
+        cache.put(_key(i, i + 1), (i, 1, True), sig=big)
+    assert cache._bytes <= budget
+    assert len(cache) == 1                         # 3x-cost entries
+
+
+def test_swap_ignores_manual_set_diff_entries(traffic_world, tmp_path):
+    """Regression pin: scoped invalidation matches survivors against
+    the previous FUSION, not ``frontend.diff`` — after a manual
+    ``set_diff`` the live entries were computed under an unrelated
+    diff the swap's affected set says nothing about, so re-keying one
+    would serve its stale cost under the new epoch."""
+    from distributed_oracle_search_tpu.data.formats import write_diff
+
+    conf, g, dc, queries, dispatcher = traffic_world
+    stream_dir = str(tmp_path / "stream")
+    manager = DiffEpochManager(stream_dir, poll_ms=1e6)  # manual pump
+    fe = ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(queue_depth=256, max_wait_ms=1.0,
+                          deadline_ms=60_000.0).validate(),
+        traffic=manager)
+    fe.start()
+    try:
+        s, t = int(queries[0][0]), int(queries[0][1])
+        mdiff = str(tmp_path / "manual.diff")
+        write_diff(mdiff, np.asarray([0]), np.asarray([1]),
+                   np.asarray([12345]))
+        fe.set_diff(mdiff)
+        assert fe.submit(s, t).result(60).ok
+        assert fe.submit(s, t).result(60).cached
+        # update ONE edge provably off the cached walk, so only the
+        # old-fusion match (not the signature check) can drop it
+        fm = first_move_to_target(g, t)
+        _c, _p, _f, path = table_search_walk(
+            g, lambda x, _t: fm[int(x)], s, t, w_query=g.w)
+        on_path = set(int(x) for x in path)
+        eid = next(e for e in range(g.m)
+                   if int(g.src[e]) not in on_path
+                   and int(g.dst[e]) not in on_path)
+        r0 = _counter("serve_cache_rekeyed_total")
+        write_segment(stream_dir, 1, [int(g.src[eid])],
+                      [int(g.dst[eid])], [int(g.w[eid]) * 2])
+        assert fe.poll_traffic()
+        assert _counter("serve_cache_rekeyed_total") == r0
+        assert not fe.submit(s, t).result(60).cached
+    finally:
+        fe.stop()
+
+
+def test_family_ingress_stream(traffic_world):
+    import io
+
+    conf, g, dc, queries, dispatcher = traffic_world
+    fe = ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(queue_depth=1024, max_wait_ms=1.0,
+                          cache_bytes=0).validate())
+    fam = QueryFamilies(fe, graph=g)
+    fe.start()
+    try:
+        s, t = int(queries[0][0]), int(queries[0][1])
+        lines = (f"{s} {t}\nmat {s} {t}\nrev {s} {t}\n"
+                 f"alt {s} {t} 2\nmat nonsense\nquit\n")
+        out = io.StringIO()
+        n = ingress.serve_stream(fe, io.StringIO(lines), out,
+                                 families=fam)
+        assert n == 4
+        got = out.getvalue().strip().split("\n")
+        assert got[0].startswith(f"OK {s} {t} ")
+        assert got[1].startswith(f"MAT {s} 1 ")
+        assert got[2].startswith(f"REV {s} {t} ")
+        assert got[3].startswith(f"ALT {s} {t} ")
+        assert got[4].startswith("ERROR -1 -1 malformed-line")
+    finally:
+        fe.stop()
+
+
+# ------------------------------------------- engine path signatures
+
+def test_engine_sig_k_answers_unchanged(traffic_world):
+    conf, g, dc, queries, dispatcher = traffic_world
+    eng = dispatcher._engine_for(0)
+    mine = queries[dc.worker_of(queries[:, 1]) == 0][:16]
+    c0, p0, f0, _ = eng.answer(mine, RuntimeConfig())
+    c1, p1, f1, _ = eng.answer(mine, RuntimeConfig(sig_k=64))
+    np.testing.assert_array_equal(c0, c1)
+    np.testing.assert_array_equal(p0, p1)
+    np.testing.assert_array_equal(f0, f1)
+    nodes, moves = eng.last_paths
+    assert nodes.shape == (len(mine), 65)
+    # a complete signature's moves equal the answered plen
+    np.testing.assert_array_equal(moves, p1)
+
+
+# --------------------------------------------- tier-1 live-swap smoke
+
+def _pair_triples(results):
+    return [(r.cost, r.plen, r.finished) for r in results]
+
+
+def test_live_swap_smoke(traffic_world, tmp_path):
+    """The acceptance gate: 100+ mixed-family queries across one LIVE
+    epoch swap — zero sheds, post-swap answers bit-identical to a
+    frontend started fresh on the swapped diff, scoped invalidation
+    keeps unaffected entries hitting."""
+    conf, g, dc, queries, dispatcher = traffic_world
+    stream_dir = str(tmp_path / "stream")
+    manager = DiffEpochManager(stream_dir, poll_ms=25.0)
+    fe = ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(queue_depth=2048, max_wait_ms=1.0,
+                          deadline_ms=60_000.0).validate(),
+        traffic=manager)
+    fam = QueryFamilies(fe, graph=g, traffic=manager)
+    shed0 = (_counter("serve_shed_busy_total"),
+             _counter("serve_shed_unavailable_total"),
+             _counter("serve_timeouts_total"),
+             _counter("serve_errors_total"))
+    fe.start()
+    try:
+        pool = [(int(s), int(t)) for s, t in queries[:60]]
+        # --- pre-swap: pairs + every family (well over 100 sub-queries)
+        pre = [fe.submit(s, t) for s, t in pool]
+        fam_futs = [fam.matrix(pool[0][0], [t for _s, t in pool[:10]]),
+                    fam.alternatives(pool[1][0], pool[1][1], 3),
+                    fam.reverse(pool[2][0], pool[2][1])]
+        pre_res = [f.result(60) for f in pre]
+        for f in fam_futs:
+            assert f.result(60) is not None
+        assert all(r.ok for r in pre_res)
+
+        # --- the swap: retime a handful of corridor edges, live
+        eids = scenarios.pick_corridor(g, frac=0.01, seed=5)
+        new_w = (g.w[eids].astype(np.int64) * 3).astype(np.int64)
+        write_segment(stream_dir, 1, g.src[eids], g.dst[eids], new_w)
+        deadline = time.monotonic() + 10.0
+        while fe._diff_epoch != 1:
+            assert time.monotonic() < deadline, "swap never applied"
+            time.sleep(0.02)
+        assert fe.diff == manager.fused_path(1)
+
+        # --- post-swap: same mixed workload on the new epoch
+        post = [fe.submit(s, t) for s, t in pool]
+        post_res = [f.result(60) for f in post]
+        assert all(r.ok for r in post_res)
+        mat = fam.matrix(pool[0][0],
+                         [t for _s, t in pool[:10]]).result(60)
+        rev = fam.reverse(pool[2][0], pool[2][1]).result(60)
+        assert mat.ok and rev.ok
+
+        # zero sheds attributable to the swap
+        assert (_counter("serve_shed_busy_total"),
+                _counter("serve_shed_unavailable_total"),
+                _counter("serve_timeouts_total"),
+                _counter("serve_errors_total")) == shed0
+
+        # scoped (not full) invalidation ran, and unaffected survivors
+        # kept hitting after the swap
+        assert _counter("serve_cache_invalidated_scoped_total") > 0
+        hits_after = [r.cached for r in post_res]
+        assert any(hits_after), "no re-keyed survivor ever hit"
+    finally:
+        fe.stop()
+
+    # --- bit-identical to a serve started FRESH on the new diff
+    fresh = ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(queue_depth=2048, max_wait_ms=1.0,
+                          cache_bytes=0,
+                          deadline_ms=60_000.0).validate(),
+        diff=manager.fused_path(1))
+    fresh.start()
+    try:
+        fresh_res = [fresh.submit(s, t).result(60) for s, t in pool]
+        assert _pair_triples(fresh_res) == _pair_triples(post_res)
+    finally:
+        fresh.stop()
+    # and correct vs the CPU reference under the fused weights
+    w_new = g.weights_with_diff(read_diff(manager.fused_path(1)))
+    exp_c, exp_p, exp_f = _reference_answers(g, pool[:12], w_new)
+    for r, ec, ep, ef in zip(post_res[:12], exp_c, exp_p, exp_f):
+        assert (r.cost, r.plen, r.finished) == (int(ec), int(ep),
+                                                bool(ef))
+
+
+# -------------------------------------------------- scenario generator
+
+def test_scenario_topologies():
+    for kind in ("grid", "powerlaw"):
+        g = scenarios.make_topology(kind, n=120, seed=3)
+        assert g.n >= 100 and g.m > g.n        # connected-ish, 2-way
+    q = scenarios.zipf_queries(100, 500, seed=4)
+    assert q.shape == (500, 2)
+    assert (q >= 0).all() and (q < 100).all()
+    assert (q[:, 0] != q[:, 1]).all()
+    # hotspots: the pool repeats pairs (what caches/dedup feed on)
+    assert len(np.unique(q, axis=0)) < len(q)
+
+
+def test_rush_hour_trace_profile():
+    g = scenarios.make_topology("grid", n=100, seed=1)
+    trace = scenarios.rush_hour_trace(g, epochs=5, frac=0.05,
+                                      peak=3.0, seed=2)
+    assert [seg["epoch"] for seg in trace] == [1, 2, 3, 4, 5]
+    eids = scenarios.pick_corridor(g, frac=0.05, seed=2)
+    base = g.w[eids].astype(np.int64)
+    mid = trace[2]["w"]
+    assert (mid >= base * 2.9).all()           # peak at the middle
+    np.testing.assert_array_equal(trace[-1]["w"], base)  # ends at base
+
+
+# ------------------------------------------ satellite: bench waivers
+
+def _bench_record(path, headline):
+    with open(path, "w") as f:
+        json.dump({"parsed": {"metric": "scenario_queries_per_sec",
+                              "value": headline.get(
+                                  "scenario_queries_per_sec", 1.0),
+                              "headline": headline}}, f)
+
+
+def test_bench_diff_waiver_gate(tmp_path):
+    from distributed_oracle_search_tpu.cli.obs import main as obs_main
+    from distributed_oracle_search_tpu.obs import fleet
+
+    d = str(tmp_path)
+    _bench_record(os.path.join(d, "BENCH_r01.json"),
+                  {"build_rows_per_sec": 300.0, "other_qps": 50.0})
+    _bench_record(os.path.join(d, "BENCH_r02.json"),
+                  {"build_rows_per_sec": 100.0, "other_qps": 60.0})
+    # ungated: the regression exits 1
+    assert obs_main(["bench-diff", "--dir", d]) == 1
+    # a waiver for a round that is NOT the newest record is rejected
+    # up front — it would be recorded but could never apply
+    with pytest.raises(SystemExit, match="cannot apply"):
+        obs_main(["bench-diff", "--dir", d, "--waive",
+                  "build_rows_per_sec=r99"])
+    # recording the waiver for THIS round passes, and is durable
+    assert obs_main(["bench-diff", "--dir", d, "--waive",
+                     "build_rows_per_sec=r02", "--waive-reason",
+                     "accepted rebaseline"]) == 0
+    assert obs_main(["bench-diff", "--dir", d]) == 0
+    rec = fleet.load_waivers(d)["build_rows_per_sec"]
+    assert rec["round"] == "r02"
+    assert rec["reason"] == "accepted rebaseline"
+    assert rec["old"] == 300.0 and rec["new"] == 100.0
+    # the waiver is per-round: a FRESH regression in r03 gates again
+    _bench_record(os.path.join(d, "BENCH_r03.json"),
+                  {"build_rows_per_sec": 30.0, "other_qps": 60.0})
+    assert obs_main(["bench-diff", "--dir", d]) == 1
+    # a waiver recorded for the WRONG round does not apply
+    out = fleet.compare_bench(
+        os.path.join(d, "BENCH_r02.json"),
+        os.path.join(d, "BENCH_r03.json"),
+        waivers={"build_rows_per_sec": {"round": "r99"}})
+    assert len(out["regressions"]) == 1 and not out["waived"]
+
+
+def test_bench_waiver_file_unreadable_fails_closed(tmp_path):
+    from distributed_oracle_search_tpu.obs import fleet
+
+    d = str(tmp_path)
+    with open(os.path.join(d, fleet.WAIVER_FILE), "w") as f:
+        f.write("{not json")
+    assert fleet.load_waivers(d) == {}         # no waivers -> gating
+
+
+# --------------------------------------------------- slow: replay drill
+
+@pytest.mark.slow
+def test_rush_hour_replay_drill(traffic_world, tmp_path):
+    """Multi-epoch rush-hour replay against a live frontend: every
+    epoch's answers pinned element-wise vs the CPU reference under that
+    epoch's fused weights; zero sheds across the whole rush."""
+    conf, g, dc, queries, dispatcher = traffic_world
+    stream_dir = str(tmp_path / "rush")
+    # keep the whole rush's fused files: the drill reads each epoch's
+    # fusion back for the reference pin AFTER serving on it, and the
+    # first batch's JIT compile can outlast several replay intervals —
+    # the default keep window would prune the file first (the keep
+    # window's own behavior is pinned by test_fused_multi_segment_swap)
+    manager = DiffEpochManager(stream_dir, poll_ms=25.0, keep_epochs=8)
+    fe = ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(queue_depth=2048, max_wait_ms=1.0,
+                          deadline_ms=60_000.0).validate(),
+        traffic=manager)
+    shed0 = (_counter("serve_shed_busy_total"),
+             _counter("serve_shed_unavailable_total"))
+    trace = scenarios.rush_hour_trace(g, epochs=4, frac=0.03,
+                                      peak=4.0, seed=9)
+    pool = [(int(s), int(t)) for s, t in queries[:16]]
+    fe.start()
+    try:
+        stop = threading.Event()
+        writer = threading.Thread(
+            target=scenarios.replay,
+            args=(trace, stream_dir), kwargs={"interval_s": 0.3,
+                                              "stop": stop},
+            daemon=True)
+        writer.start()
+        seen = set()
+        deadline = time.monotonic() + 60.0
+        try:
+            while len(seen) < 2 and time.monotonic() < deadline:
+                ep = fe._diff_epoch
+                if ep and ep not in seen:
+                    seen.add(ep)
+                    res = [fe.submit(s, t).result(60) for s, t in pool]
+                    assert all(r.ok for r in res)
+                    w_ep = g.weights_with_diff(
+                        read_diff(manager.fused_path(ep)))
+                    ec, ep_, ef = _reference_answers(g, pool, w_ep)
+                    # pin only answers still computed under ep (a swap
+                    # mid-collection is legal; skip if epoch moved)
+                    if fe._diff_epoch == ep:
+                        for r, c, p, f in zip(res, ec, ep_, ef):
+                            assert (r.cost, r.plen, r.finished) == (
+                                int(c), int(p), bool(f))
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            writer.join(timeout=10)
+        assert seen, "replay produced no epoch swaps"
+        assert (_counter("serve_shed_busy_total"),
+                _counter("serve_shed_unavailable_total")) == shed0
+    finally:
+        fe.stop()
