@@ -590,6 +590,14 @@ def main() -> None:
     achieved_gather = ((n_queries / (dgrid * wgrid)) * mean_plen
                        * gathers_per_step / t_kern_s)
     issued_gather = lanes_issued * gathers_per_step / t_kern_s
+    # honest lane accounting: walk_gather_utilization rewards padded
+    # lanes (wider buckets inflate the issued rate while slowing the
+    # answer — the table_search.py knob comment). useful_lane_fraction
+    # is the unskewed figure for kernel-vs-kernel comparisons: real
+    # moves of non-pad queries over ALL issued lane-steps, fleet-wide
+    lanes_issued_total = float(lanes_dev.sum())
+    useful_lane_fraction = (float(plen_dwq.sum()) / lanes_issued_total
+                            if lanes_issued_total else 0.0)
     log(f"roofline: kernel {t_kern_s:.3f}s, peak gather "
         f"{peak_gather / 1e6:,.0f} M elem/s, "
         f"useful {achieved_gather / 1e6:,.0f} "
@@ -605,18 +613,85 @@ def main() -> None:
     walk_costs = obs_device.analyze(
         kern_fn, oracle.dg, oracle.fm, ra_d, sa_d, ta_d, va_d,
         oracle.dg.w_pad)
+    walk_costs = obs_device.derive_bandwidth(
+        walk_costs, t_kern_s, hbm_bw / 1e9)
     if walk_costs:
-        if "bytes_accessed" in walk_costs:
-            gbps = walk_costs["bytes_accessed"] / t_kern_s / 1e9
-            walk_costs["achieved_gbps"] = round(gbps, 2)
-            walk_costs["hbm_bw_utilization"] = round(
-                gbps / (hbm_bw / 1e9), 4)
+        if "achieved_gbps" in walk_costs:
             log(f"roofline (XLA): {walk_costs.get('flops', 0):,.0f} "
                 f"FLOPs, {walk_costs['bytes_accessed'] / 1e6:,.1f} MB "
-                f"accessed -> {gbps:,.1f} GB/s achieved "
-                f"({walk_costs['hbm_bw_utilization']:.0%} of the "
-                f"streamed-HBM peak)")
+                f"accessed -> {walk_costs['achieved_gbps']:,.1f} GB/s "
+                f"achieved ({walk_costs['hbm_bw_utilization']:.0%} of "
+                f"the streamed-HBM peak)")
         obs_device.record("walk-kernel", walk_costs)
+
+    # ---- Pallas-fused walk kernel (ops.pallas_walk): the SAME routed
+    # pack through the fused kernel — answers asserted bit-identical,
+    # wall-clock and XLA cost capture keyed NEXT TO the XLA kernel's so
+    # BENCH_DETAIL carries both sides of the roofline comparison. Real
+    # chip only: interpret mode is a correctness tool, its timing says
+    # nothing about the gap this kernel exists to close. BENCH_PALLAS=0
+    # skips.
+    pallas_roof = {}
+    if (devices[0].platform == "tpu"
+            and os.environ.get("BENCH_PALLAS", "1") != "0"):
+        # same VMEM-fit guard as the production callers (engine /
+        # CPDOracle): an over-budget shape must SKIP the section, not
+        # fault on-chip and take the rest of the bench down with it
+        from distributed_oracle_search_tpu.ops import pallas_walk_fits
+        q_local = int(ra.shape[2]) * max(
+            int(ra.shape[0]) // oracle.mesh.shape[DATA_AXIS], 1)
+        fits, fit_why = pallas_walk_fits(
+            oracle.dg.n, oracle.dg.k,
+            int(oracle.dg.w_pad.shape[0]) - 1, q_local)
+    else:
+        fits, fit_why = False, ""
+    if fit_why:
+        log(f"walk pallas: skipped — {fit_why}")
+    if fits:
+        pk_fn = _query_fn(oracle.mesh, 0, -1, "pallas")
+
+        def _pallas_walk_call():
+            return jax.block_until_ready(pk_fn(
+                oracle.dg, oracle.fm, ra_d, sa_d, ta_d, va_d,
+                oracle.dg.w_pad))
+        with Timer() as t_pwarm:
+            outs_p = _pallas_walk_call()     # compile + parity capture
+        cost_p, plen_p, fin_p = (np.asarray(o) for o in outs_p)
+        cost_x, plen_x, fin_x = (np.asarray(o) for o in kern_fn(
+            oracle.dg, oracle.fm, ra_d, sa_d, ta_d, va_d,
+            oracle.dg.w_pad))
+        assert (cost_p == cost_x).all() and (plen_p == plen_x).all() \
+            and (fin_p == fin_x).all(), \
+            "fused walk kernel diverged from the XLA walk"
+        _, t_pallas_s = robust_time(_pallas_walk_call, reps=3,
+                                    label="walk-kernel-pallas")
+        pallas_qps = n_queries / t_pallas_s
+        pallas_costs = obs_device.derive_bandwidth(
+            obs_device.analyze(pk_fn, oracle.dg, oracle.fm, ra_d, sa_d,
+                               ta_d, va_d, oracle.dg.w_pad),
+            t_pallas_s, hbm_bw / 1e9)
+        if pallas_costs:
+            obs_device.record("walk-kernel-pallas", pallas_costs)
+        pallas_roof = {
+            "walk_pallas_kernel_seconds": round(t_pallas_s, 4),
+            "walk_pallas_queries_per_sec": round(pallas_qps, 1),
+            "walk_pallas_speedup": round(t_kern_s / t_pallas_s, 3),
+            # the fused kernel walks the SAME bucket grid, so its lane
+            # accounting is the XLA figure — keyed separately anyway so
+            # a future grid change keeps the comparison honest
+            "walk_pallas_useful_lane_fraction": round(
+                useful_lane_fraction, 3),
+            **({"walk_pallas_bytes_accessed":
+                    pallas_costs.get("bytes_accessed"),
+                "walk_pallas_achieved_gbps":
+                    pallas_costs.get("achieved_gbps"),
+                "walk_pallas_hbm_bw_utilization":
+                    pallas_costs.get("hbm_bw_utilization")}
+               if pallas_costs else {}),
+        }
+        log(f"walk pallas: kernel {t_pallas_s:.3f}s (compile "
+            f"{t_pwarm.interval:.2f}s) -> {pallas_qps:,.0f} q/s, "
+            f"{t_kern_s / t_pallas_s:.2f}x the XLA walk")
 
     # ---- measured CPU denominator: the SAME graph + scenario through the
     # native OpenMP engine (full build + resident fifo_auto campaign over
@@ -2050,6 +2125,10 @@ def main() -> None:
                 issued_gather / peak_gather, 3),
             "walk_issue_efficiency": round(
                 achieved_gather / issued_gather, 3),
+            # non-pad lanes / issued lanes: the padding-proof figure
+            # for kernel-vs-kernel roofline comparisons (see the
+            # honest-lane-accounting note at its computation)
+            "walk_useful_lane_fraction": round(useful_lane_fraction, 3),
             "hbm_stream_gbps": round(hbm_bw / 1e9, 1),
             # XLA cost/memory analysis of the walk program + the derived
             # achieved-vs-peak gather-bandwidth figure (obs.device)
@@ -2060,6 +2139,9 @@ def main() -> None:
                 "walk_hbm_bw_utilization":
                     walk_costs.get("hbm_bw_utilization")}
                if walk_costs else {}),
+            # fused Pallas walk kernel, keyed next to the XLA figures
+            # (empty off-TPU / under BENCH_PALLAS=0)
+            **pallas_roof,
         },
         **scale_stats,
         **road_stats,
@@ -2121,6 +2203,11 @@ def main() -> None:
         detail["roofline"]["walk_gather_utilization"]
     headline["walk_issue_efficiency"] = \
         detail["roofline"]["walk_issue_efficiency"]
+    headline["walk_useful_lane_fraction"] = \
+        detail["roofline"]["walk_useful_lane_fraction"]
+    for k in ("walk_pallas_queries_per_sec", "walk_pallas_speedup"):
+        if k in detail["roofline"]:
+            headline[k] = detail["roofline"][k]
     line = json.dumps({
         "metric": payload["metric"],
         "value": payload["value"],

@@ -1064,6 +1064,9 @@ class CPDOracle:
         self.targets_wr = pad_targets(controller)
         self.fm = None     # int8 [W, R, N], sharded on worker axis
         self.dists = None  # optional int32 [W, R, N] (build(store_dists=True))
+        # one log line per oracle when a pallas-requested batch falls
+        # back to XLA on the VMEM-fit check (not one per query call)
+        self._walk_fallback_logged = False
 
     # ------------------------------------------------------------- build
     def build(self, chunk: int = 0, max_iters: int = 0,
@@ -1286,9 +1289,31 @@ class CPDOracle:
             self.graph.padded_weights(w_query), jnp.int32)
         outs = _host_tree(query_sharded(
             self.dg, self.fm, r_arr, s_arr, t_arr, valid, w_pad, self.mesh,
-            k_moves=k_moves, max_steps=max_steps))
+            k_moves=k_moves, max_steps=max_steps,
+            kernel=self._walk_kernel(r_arr.shape)))
         return tuple(self._unroute(scatter, len(queries), outs,
                                    (False, False, False)))
+
+    def _walk_kernel(self, routed_shape) -> str:
+        """Resolve ``DOS_WALK_KERNEL`` for one routed batch: ``auto``
+        picks the Pallas-fused walk on real TPU backends, and a
+        pallas choice whose per-device working set exceeds the VMEM
+        budget degrades to the XLA reference walk (logged once). The
+        policy itself lives in ``ops.pallas_walk.choose_walk_kernel``
+        — this method only supplies the shard-local batch size."""
+        from ..ops.pallas_walk import choose_walk_kernel
+
+        dgrid, _, qmax = routed_shape
+        # the shard-local flat batch: [D/|data|, 1, Q] reshaped to -1
+        q_local = max(dgrid // max(self.mesh.shape[DATA_AXIS], 1), 1) \
+            * qmax
+        kernel, why = choose_walk_kernel(
+            self.dg.n, self.dg.k, int(self.dg.w_pad.shape[0]) - 1,
+            q_local)
+        if why and not self._walk_fallback_logged:
+            log.warning("%s", why)
+            self._walk_fallback_logged = True
+        return kernel
 
     def query_multi(self, queries: np.ndarray,
                     w_diffs: list[np.ndarray | None],

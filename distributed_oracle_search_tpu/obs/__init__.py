@@ -212,7 +212,14 @@ series every resident process exposes):
   (gauge) plus per-program ``device_program_flops`` /
   ``device_program_bytes_accessed`` / ``device_program_hbm_bytes``
   labeled gauges, captured once per engine program-cache key and
-  embedded in ``BENCH_DETAIL.json`` as the roofline denominators.
+  embedded in ``BENCH_DETAIL.json`` as the roofline denominators;
+* walk-kernel selection (``ops.pallas_walk`` via ``worker.engine``) —
+  ``walk_{pallas,xla}_batches_total``: table-search batches by the
+  kernel that answered them (``DOS_WALK_KERNEL`` resolution; a
+  pallas-requested batch that failed the VMEM-fit check books the
+  xla counter — the fleet-wide signal that ``auto`` actually engaged
+  the fused kernel, next to its ``table-search[pallas]/...`` program
+  cost capture).
 """
 
 from . import device, fleet, metrics, quantiles, trace

@@ -116,6 +116,24 @@ def capture(key, fn, *args, **kwargs) -> dict | None:
         return _COSTS[skey]
 
 
+def derive_bandwidth(entry: dict | None, seconds: float,
+                     peak_gbps: float | None) -> dict | None:
+    """Fold a measured wall-clock into a captured analysis: achieved
+    GB/s off ``bytes_accessed`` plus the utilization fraction against a
+    calibrated HBM peak. The roofline arithmetic the bench used to
+    inline for the XLA walk, shared here so the fused Pallas kernel's
+    capture derives the SAME figures (kernel-vs-kernel comparisons must
+    not differ in the denominator math). Mutates and returns ``entry``;
+    None in (no analysis / no timing) degrades to None out."""
+    if not entry or seconds <= 0 or "bytes_accessed" not in entry:
+        return entry
+    gbps = entry["bytes_accessed"] / seconds / 1e9
+    entry["achieved_gbps"] = round(gbps, 2)
+    if peak_gbps and peak_gbps > 0:
+        entry["hbm_bw_utilization"] = round(gbps / peak_gbps, 4)
+    return entry
+
+
 def record(key, entry: dict) -> None:
     """Store an externally computed analysis under ``key`` (bench uses
     this for programs it lowers itself)."""

@@ -276,6 +276,33 @@ DEFAULT_TOLERANCE = 0.3
 _LOWER_BETTER = re.compile(
     r"(_ms|_seconds|_s)$|(^|_)p\d+_ms$|break[-_]?even")
 
+#: explicit per-key directions for headline keys whose names defeat the
+#: suffix heuristic — the walk-kernel roofline family (PR 10): q/s and
+#: utilization/efficiency fractions improve UP, stall/kernel time
+#: improves DOWN (listed even where the suffix would catch it, so the
+#: family's contract is in one place)
+_KEY_DIRECTIONS = {
+    "walk_gather_utilization": "higher",
+    "walk_issue_efficiency": "higher",
+    "walk_useful_lane_fraction": "higher",
+    "walk_pallas_useful_lane_fraction": "higher",
+    "walk_pallas_queries_per_sec": "higher",
+    "walk_pallas_speedup": "higher",
+    "walk_pallas_kernel_seconds": "lower",
+    "walk_pallas_stall_p99_ms": "lower",
+}
+
+#: per-key default tolerances (CLI --key-tolerance still overrides):
+#: lane/utilization fractions are stable kernel properties — a real
+#: regression there is structural, so gate them tighter than raw
+#: throughput on the jittery tunneled link
+_KEY_TOLERANCES = {
+    "walk_useful_lane_fraction": 0.15,
+    "walk_pallas_useful_lane_fraction": 0.15,
+    "walk_gather_utilization": 0.15,
+    "walk_issue_efficiency": 0.15,
+}
+
 
 def find_bench_records(dirname: str) -> list[str]:
     """``BENCH_r*.json`` sorted by round number."""
@@ -387,12 +414,15 @@ def compare_bench(old_path: str, new_path: str,
     new_round = bench_round(new_path)
     regressions, improved, waived, checked = [], [], [], []
     for key in sorted(set(old) & set(new)):
-        tol = key_tolerances.get(key, tolerance)
+        tol = key_tolerances.get(
+            key, _KEY_TOLERANCES.get(key, tolerance))
         ov, nv = old[key], new[key]
         checked.append(key)
         if ov == 0:
             continue
-        lower_better = bool(_LOWER_BETTER.search(key))
+        direction = _KEY_DIRECTIONS.get(key)
+        lower_better = (direction == "lower" if direction
+                        else bool(_LOWER_BETTER.search(key)))
         ratio = nv / ov
         entry = {"key": key, "old": ov, "new": nv,
                  "ratio": round(ratio, 3), "tolerance": tol,

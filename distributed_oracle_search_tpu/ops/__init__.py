@@ -1,6 +1,10 @@
 from .device_graph import DeviceGraph
 from .bellman_ford import dist_to_targets, first_move_from_dist, build_fm_columns
 from .table_search import extract_paths, table_search_batch
+from .pallas_walk import (
+    choose_walk_kernel, pallas_walk_batch, pallas_walk_fits,
+    resolve_walk_kernel,
+)
 from .pointer_doubling import doubled_tables, lookup_tables
 from .shift_relax import ShiftGraph, dist_to_targets_shift
 from .batched_astar import astar_batch, astar_batch_np
@@ -8,6 +12,8 @@ from .batched_astar import astar_batch, astar_batch_np
 __all__ = [
     "DeviceGraph", "dist_to_targets", "first_move_from_dist",
     "build_fm_columns", "table_search_batch", "extract_paths",
+    "choose_walk_kernel", "pallas_walk_batch", "pallas_walk_fits",
+    "resolve_walk_kernel",
     "doubled_tables", "lookup_tables", "ShiftGraph",
     "dist_to_targets_shift", "astar_batch", "astar_batch_np",
 ]

@@ -419,7 +419,8 @@ def query_dist_sharded(dist_wrn: jax.Array, t_rows: np.ndarray,
 
 
 @functools.lru_cache(maxsize=None)
-def _query_fn(mesh: Mesh, max_steps: int, k_moves: int = -1):
+def _query_fn(mesh: Mesh, max_steps: int, k_moves: int = -1,
+              kernel: str = "xla"):
     q3 = P(DATA_AXIS, WORKER_AXIS, None)
 
     def _local(dg, fm_local, rows, s, t, valid, w_pad):
@@ -427,10 +428,16 @@ def _query_fn(mesh: Mesh, max_steps: int, k_moves: int = -1):
         # k_moves is part of THIS function's cache key (a per-campaign
         # constant), so the kernel sees a Python int and its static
         # no-budget specialization applies — a traced k_moves operand
-        # would force the per-step budget compare back in
+        # would force the per-step budget compare back in. `kernel`
+        # joins the key the same way: "pallas" swaps in the fused walk
+        # (ops.pallas_walk, bit-identical answers) per shard
         fm2 = fm_local[0]
         shape = s.shape
-        cost, plen, fin = table_search_batch(
+        if kernel == "pallas":
+            from ..ops.pallas_walk import pallas_walk_batch as walk
+        else:
+            walk = table_search_batch
+        cost, plen, fin = walk(
             dg, fm2, rows.reshape(-1), s.reshape(-1), t.reshape(-1), w_pad,
             valid=valid.reshape(-1), k_moves=k_moves, max_steps=max_steps)
         return (cost.reshape(shape), plen.reshape(shape), fin.reshape(shape))
@@ -487,12 +494,16 @@ def query_multi_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
 def query_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
                   t_rows: np.ndarray, s: np.ndarray, t: np.ndarray,
                   valid: np.ndarray, w_query_pad, mesh: Mesh,
-                  k_moves: int = -1, max_steps: int = 0):
+                  k_moves: int = -1, max_steps: int = 0,
+                  kernel: str = "xla"):
     """Answer routed query batches on the mesh.
 
     Inputs are ``[D, W, Q]`` (data axis × worker axis × padded queries):
     ``t_rows`` = local fm row of each query's target, ``valid`` masks
     padding. Returns ``(cost, plen, finished)`` each ``[D, W, Q]``.
+    ``kernel``: ``"xla"`` (the reference walk) or ``"pallas"`` (the
+    fused kernel, ``ops.pallas_walk``) — callers resolve the
+    ``DOS_WALK_KERNEL`` knob, this layer just compiles what it is told.
     """
     qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
     # ONE device_put for the whole query pack: each separate transfer
@@ -500,5 +511,5 @@ def query_sharded(dg: DeviceGraph, fm_wrn: jax.Array,
     # and never jnp.asarray first — that is a second, default-device
     # transfer before the resharding copy
     args = jax.device_put((t_rows, s, t, valid), qs)
-    fn = _query_fn(mesh, max_steps, int(k_moves))
+    fn = _query_fn(mesh, max_steps, int(k_moves), str(kernel))
     return fn(dg, fm_wrn, *args, jnp.asarray(w_query_pad))

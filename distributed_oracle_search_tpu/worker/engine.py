@@ -63,6 +63,14 @@ M_DUPS = obs_metrics.counter(
     "worker_duplicate_queries_total",
     "queries answered from another identical (s, t) pair in the same "
     "batch — the kernel only runs each distinct pair once")
+M_WALK_PALLAS = obs_metrics.counter(
+    "walk_pallas_batches_total",
+    "table-search batches answered by the Pallas-fused walk kernel "
+    "(DOS_WALK_KERNEL selection, ops.pallas_walk)")
+M_WALK_XLA = obs_metrics.counter(
+    "walk_xla_batches_total",
+    "table-search batches answered by the XLA reference walk "
+    "(includes pallas-requested batches that fell back on VMEM fit)")
 
 
 def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
@@ -210,6 +218,9 @@ class ShardEngine:
         self._astar_ctx: dict = {}
         #: path prefixes of the most recent extract batch (see answer())
         self.last_paths: tuple[np.ndarray, np.ndarray] | None = None
+        #: one log line per engine when a pallas-requested batch falls
+        #: back to XLA on the VMEM-fit check (not one per batch)
+        self._walk_fallback_logged = False
 
     # ------------------------------------------------------------ weights
     def _weights_for(self, difffile: str, no_cache: bool):
@@ -246,6 +257,7 @@ class ShardEngine:
         """
         import jax
         import jax.numpy as jnp
+        from ..ops.pallas_walk import choose_walk_kernel, pallas_walk_batch
         from ..ops.table_search import extract_paths, table_search_batch
 
         set_worker_id(self.wid)
@@ -337,8 +349,26 @@ class ShardEngine:
                 shape_key = self.astar_chunk
             else:
                 shape_key = qpad
+            # kernel selection (DOS_WALK_KERNEL): the Pallas-fused walk
+            # on real TPU backends under `auto`, the XLA walk otherwise
+            # — with a VMEM-fit degrade so an oversized shard falls
+            # back to the reference path instead of faulting on-chip.
+            # The choice joins the jit key: each kernel compiles (and
+            # books its first-call compile time) separately.
+            call_q = (self.astar_chunk
+                      if config.time and qpad > self.astar_chunk
+                      else qpad)
+            kernel, why = choose_walk_kernel(
+                self.dg.n, self.dg.k, int(self.dg.w_pad.shape[0]) - 1,
+                call_q)
+            if why and not self._walk_fallback_logged:
+                log.warning("%s", why)
+                self._walk_fallback_logged = True
+            walk_fn = (pallas_walk_batch if kernel == "pallas"
+                       else table_search_batch)
+            (M_WALK_PALLAS if kernel == "pallas" else M_WALK_XLA).inc()
             jit_key = (self.alg, shape_key, config.k_moves, extracting,
-                       config.sig_k if config.sig_k > 0 else 0)
+                       config.sig_k if config.sig_k > 0 else 0, kernel)
         first_call = jit_key not in self._jit_seen
         if self.alg == "astar":
             deadline = t1 + config.time / 1e9 if config.time else None
@@ -356,7 +386,7 @@ class ShardEngine:
         deadline = t1 + config.time / 1e9 if config.time else None
         for _ in range(max(config.itrs, 1)):
             if deadline is None or qpad <= self.astar_chunk:
-                cost, plen, fin = table_search_batch(
+                cost, plen, fin = walk_fn(
                     self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
                     jnp.asarray(t), w_pad, valid=jnp.asarray(valid),
                     k_moves=config.k_moves)
@@ -390,7 +420,7 @@ class ShardEngine:
                     if off and time.perf_counter() > deadline:
                         break
                     sl = slice(off, off + ch)
-                    outs = table_search_batch(
+                    outs = walk_fn(
                         self.dg, self.fm, jnp.asarray(rows[sl]),
                         jnp.asarray(s[sl]), jnp.asarray(t[sl]), w_pad,
                         valid=jnp.asarray(valid[sl]),
@@ -442,12 +472,28 @@ class ShardEngine:
                      if deadline is not None and qpad > self.astar_chunk
                      else qpad)
             sl = slice(0, cap_n)
-            obs_device.capture(
-                f"table-search/q{cap_n}/k{config.k_moves}",
-                table_search_batch, self.dg, self.fm,
-                jnp.asarray(rows[sl]), jnp.asarray(s[sl]),
-                jnp.asarray(t[sl]), w_pad,
-                valid=jnp.asarray(valid[sl]), k_moves=config.k_moves)
+            if kernel == "pallas":
+                # the fused kernel's statics live in a closure so the
+                # capture's AOT lower sees only array operands (its
+                # interpret/bucket resolution runs at trace time)
+                km = config.k_moves
+
+                def _cap_fn(dgx, fmx, r_, s_, t_, w_, v_):
+                    return pallas_walk_batch(dgx, fmx, r_, s_, t_, w_,
+                                             valid=v_, k_moves=km)
+
+                obs_device.capture(
+                    f"table-search[pallas]/q{cap_n}/k{config.k_moves}",
+                    _cap_fn, self.dg, self.fm, jnp.asarray(rows[sl]),
+                    jnp.asarray(s[sl]), jnp.asarray(t[sl]), w_pad,
+                    jnp.asarray(valid[sl]))
+            else:
+                obs_device.capture(
+                    f"table-search/q{cap_n}/k{config.k_moves}",
+                    table_search_batch, self.dg, self.fm,
+                    jnp.asarray(rows[sl]), jnp.asarray(s[sl]),
+                    jnp.asarray(t[sl]), w_pad,
+                    valid=jnp.asarray(valid[sl]), k_moves=config.k_moves)
 
         cost = np.asarray(cost[:nu], np.int64)[unsort]
         plen = np.asarray(plen[:nu], np.int64)[unsort]

@@ -19,6 +19,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # regression check (utils.locks witness graph; cycles raise at the
 # acquire that would make deadlock possible)
 os.environ.setdefault("DOS_LOCK_CHECK", "1")
+# pin the walk-kernel knob for tier-1: the XLA walk is the reference
+# path every existing suite runs on, and the Pallas-fused kernel is
+# exercised EXPLICITLY by tests/test_pallas_walk.py in interpret mode
+# (it opts in per test). A hard override — not setdefault — so a
+# container env carrying DOS_WALK_KERNEL=pallas can neither slow the
+# whole suite to interpret speed nor let the parity suite silently
+# stop comparing the two kernels against each other.
+os.environ["DOS_WALK_KERNEL"] = "xla"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -27,9 +27,43 @@ def suppressed_sleep(x):
     return x + 1
 
 
+def bad_pallas_traced_clock(x_ref, o_ref):
+    time.time()
+    o_ref[...] = x_ref[...] * 2
+
+
+def bad_pallas_captured_mutation(x_ref, o_ref):
+    _captured.append(x_ref)
+    o_ref[...] = x_ref[...]
+
+
+def suppressed_pallas_print(x_ref, o_ref):
+    # dos-lint: disable=jit-purity -- fixture: trace-time print wanted
+    #   to exercise pallas_call suppression
+    print("tracing")
+    o_ref[...] = x_ref[...]
+
+
+def _invoke_pallas(pallas_call, x):
+    # marks the kernels above as pallas_call-wrapped (the rule's
+    # _wrapped_names path — same mechanism as jax.jit(fn))
+    pallas_call(bad_pallas_traced_clock)(x)
+    pallas_call(bad_pallas_captured_mutation)(x)
+    pallas_call(suppressed_pallas_print)(x)
+
+
 @jax.jit
 def clean_pure(x):
     y = x * 2
     local = [y]
     local.append(y + 1)
     return local[0] + local[1]
+
+
+def clean_pallas_kernel(x_ref, o_ref):
+    scratch = x_ref[...] * 2
+    o_ref[...] = scratch + 1
+
+
+def _invoke_clean_pallas(pallas_call, x):
+    pallas_call(clean_pallas_kernel)(x)
