@@ -946,16 +946,24 @@ def main() -> None:
             # fits a 16 GB chip with the pipelined double-block drain
 
             def _reset_scale():         # builds resume off block files
+                # the ledger goes too: it grows a journal line per
+                # block per rep, and leaving it would hand the next
+                # rep a fatter journal even with resume off
                 for f in os.listdir(outdir):
-                    if f.startswith("cpd-"):
+                    if f.startswith(("cpd-", "build-")):
                         os.unlink(os.path.join(outdir, f))
             # band: candidate r04 measured 43 s (297 rows/s); the record
             # capture's 116 s was a documented >2.5x stall — 70 s flags
             # it. Absolute-seconds bands only apply at the default knobs.
             scale_default = side == 320 and sc_chunk == 1024
+            # resume=False hoists the per-block ledger re-read out of
+            # the timed region: scale_build_rows_per_sec measures
+            # compute + block writes, not journal parsing (the reset
+            # already guarantees every block is missing)
             _, t_b2_s = robust_time(
                 lambda: build_worker_shard(g2, dc2, 0, outdir,
-                                           chunk=sc_chunk, method="sweep"),
+                                           chunk=sc_chunk, method="sweep",
+                                           resume=False),
                 reset=_reset_scale,
                 band_s=70.0 if scale_default else None,
                 label="scale-build")
@@ -1444,6 +1452,111 @@ def main() -> None:
         finally:
             shutil.rmtree(out3, ignore_errors=True)
 
+    # ---- delta builds: incremental CPD refresh for one diff epoch vs a
+    # full rebuild on the retimed graph (ROADMAP item 1's second half).
+    # Deliberately CPU-measurable: the ratio is work-skipped / work-done
+    # — a property of the tense-edge dirty pass and the block byte-copy
+    # path, not of the device. The delta timing INCLUDES the dirty-set
+    # pass and the manifest write (that is the end-to-end refresh a
+    # traffic epoch pays). BENCH_DELTA=0 skips.
+    delta_stats = {}
+    if os.environ.get("BENCH_DELTA", "1") != "0":
+        from distributed_oracle_search_tpu.data import write_diff
+        from distributed_oracle_search_tpu.data.graph import (
+            Graph as _DGraph,
+        )
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, delta_build_index, epoch_index_dir,
+            write_index_manifest,
+        )
+
+        dside = int(os.environ.get("BENCH_DELTA_SIDE", 48))
+        dhot = int(os.environ.get("BENCH_DELTA_HOT", 2))
+        gd = synth_city_graph(dside, dside, seed=2)
+        wd = 4
+        per_wd = -(-gd.n // wd)
+        dcd = DistributionController("div", per_wd, wd, gd.n)
+        ddir = tempfile.mkdtemp(prefix="dos-delta-")
+        try:
+            log(f"delta build: n={gd.n}, {wd} shards, {dhot}-edge "
+                "congestion hotspot...")
+            for wid in range(wd):
+                build_worker_shard(gd, dcd, wid, ddir, chunk=512)
+            write_index_manifest(ddir, dcd)
+            # LOCALIZED retime — a congestion hotspot (edges from one
+            # small id window = one spatial pocket after the grid
+            # layout, weights doubled), the traffic plane's actual
+            # workload shape. A same-size RANDOM scatter on a graph
+            # this small marks every row dirty (each edge's co-optimal
+            # cone is a few % of a 2k-node graph; dozens of them union
+            # to all of it) — that regime is what the
+            # DOS_BUILD_DELTA_MAX_FRAC degrade-to-full guard is for,
+            # not what this section measures
+            rng = np.random.default_rng(13)
+            hot_eids = np.nonzero(gd.src < gd.n // 32)[0]
+            eids = rng.choice(hot_eids, size=min(dhot, len(hot_eids)),
+                              replace=False)
+            fused = os.path.join(ddir, "fused-e000001.diff")
+            write_diff(fused, gd.src[eids], gd.dst[eids],
+                       gd.w[eids].astype(np.int64) * 2)
+            g_ret = _DGraph(gd.xs, gd.ys, gd.src, gd.dst,
+                            gd.weights_with_diff(fused))
+
+            fdir = os.path.join(ddir, "full")
+
+            def _reset_full():
+                shutil.rmtree(fdir, ignore_errors=True)
+
+            def _full():
+                for wid in range(wd):
+                    build_worker_shard(g_ret, dcd, wid, fdir,
+                                       chunk=512, resume=False)
+            _reset_full()
+            _, t_fullb = robust_time(_full, reset=_reset_full,
+                                     label="delta-full-build")
+
+            edir = epoch_index_dir(ddir, 1)
+
+            def _reset_delta():
+                shutil.rmtree(edir, ignore_errors=True)
+
+            rep_box = {}
+
+            def _delta():
+                rep_box["rep"] = delta_build_index(gd, dcd, ddir, fused,
+                                                   resume=False)
+            _reset_delta()
+            _, t_deltab = robust_time(_delta, reset=_reset_delta,
+                                      label="delta-build")
+            rep = rep_box["rep"]
+            # correctness gate: the incremental index must be BIT-
+            # IDENTICAL to the from-scratch build on the retimed graph
+            for f in sorted(os.listdir(fdir)):
+                if f.startswith("cpd-"):
+                    assert (open(os.path.join(edir, f), "rb").read()
+                            == open(os.path.join(fdir, f), "rb").read()
+                            ), f"delta block {f} != full rebuild"
+            ratio = t_fullb / t_deltab
+            log(f"delta build: full {t_fullb:.2f}s vs delta "
+                f"{t_deltab:.2f}s -> {ratio:.2f}x "
+                f"({rep['rows_recomputed']}/{gd.n} rows recomputed, "
+                f"{rep['blocks_skipped']} block(s) byte-copied, "
+                f"{rep['changed_edges']} edges changed)")
+            delta_stats = {
+                "build_delta_nodes": gd.n,
+                "build_delta_changed_edges": rep["changed_edges"],
+                "build_delta_affected_rows": rep["affected_rows"],
+                "build_delta_rows_recomputed": rep["rows_recomputed"],
+                "build_delta_skipped_blocks": rep["blocks_skipped"],
+                "build_full_seconds": round(t_fullb, 3),
+                "build_delta_seconds": round(t_deltab, 3),
+                "build_full_rows_per_sec": round(gd.n / t_fullb, 1),
+                "build_delta_rows_per_sec": round(gd.n / t_deltab, 1),
+                "build_delta_vs_full_ratio": round(ratio, 2),
+            }
+        finally:
+            shutil.rmtree(ddir, ignore_errors=True)
+
     # ---- weak scaling: same total rows over 1/2/4/8 virtual CPU devices,
     # decomposed into mesh wall-clock (oversubscribed: 8 threads on one
     # core) and per-shard single-device time (the per-chip unit; with
@@ -1498,8 +1611,11 @@ def main() -> None:
                 def _reset_sh():      # resume would skip existing blocks
                     shutil.rmtree(d)
                     os.makedirs(d)
+                # resume=False: the reset guarantees an empty dir, so
+                # the ledger read would be pure timed-region overhead
                 _, t_sh_s = robust_time(
-                    lambda: build_worker_shard(g, dcw, 0, d, chunk=chunk),
+                    lambda: build_worker_shard(g, dcw, 0, d, chunk=chunk,
+                                               resume=False),
                     reset=_reset_sh,
                     # ~2x the best r05 readings per W, default knobs only
                     band_s=({1: 4.0, 2: 2.2, 4: 1.4, 8: 0.9}[wsh]
@@ -2145,6 +2261,7 @@ def main() -> None:
         },
         **scale_stats,
         **road_stats,
+        **delta_stats,
         **weak_stats,
         **serve_stats,
         **repl_stats,
@@ -2191,6 +2308,7 @@ def main() -> None:
         "road_build_parity_cores", "road_tpu_build_rows_per_sec",
         "road_stream_queries_per_sec", "road_resident_queries_per_sec",
         "road_tpu_resident_speedup", "road_multidiff_fused_speedup",
+        "build_delta_vs_full_ratio", "build_delta_rows_per_sec",
         "shard_strong_scaling_rows_per_sec",
         "serve_queries_per_sec", "serve_p99_ms",
         "serve_cache_hit_rate", "serve_mean_batch_fill",

@@ -164,6 +164,22 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                           "ledger (default: resume — only blocks whose "
                           "ledger digest no longer matches the file are "
                           "recomputed).")
+    new.add_argument("--delta-from", type=str, default=None,
+                     metavar="OLD_INDEX",
+                     help="make_cpds: DELTA rebuild — given this "
+                          "existing index plus a fused diff (--diff), "
+                          "recompute only the rows whose first-move "
+                          "entries can change (tense-edge pass), byte-"
+                          "copy untouched blocks, and write an epoch-"
+                          "tagged index under OLD_INDEX/epoch-e<N> "
+                          "that the serve path can promote without "
+                          "restart. Bit-identical to a from-scratch "
+                          "build on the retimed graph.")
+    new.add_argument("--delta-epoch", type=int, default=None,
+                     help="diff epoch tag for --delta-from (default: "
+                          "parsed from the fused diff's "
+                          "fused-e<N>.diff name, else the old "
+                          "manifest's diff_epoch + 1).")
     new.add_argument("--verify", action="store_true",
                      help="make_cpds: check-only integrity pass over the "
                           "conf's index — every manifest block is digest/"

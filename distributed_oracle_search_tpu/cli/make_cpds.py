@@ -18,7 +18,11 @@ for each worker start the per-worker CPD build.
 (reference ``make_cpds.py:27-41,58-62``). ``--verify`` runs a
 check-only integrity pass over the conf's index instead of building
 (exit 0/3/4 clean/degraded/corrupt); ``--no-resume`` disables the
-ledger-based crash-resume (on by default).
+ledger-based crash-resume (on by default). ``--delta-from OLD --diff
+FUSED`` runs a DELTA rebuild: only rows the fused diff's changed edges
+can affect are recomputed, untouched blocks byte-copy, and the result
+lands as an epoch-tagged index (``OLD/epoch-e<N>``) the serve path can
+promote without restart.
 """
 
 from __future__ import annotations
@@ -133,6 +137,48 @@ def run_verify(conf: ClusterConfig) -> int:
     return code
 
 
+def run_delta(conf: ClusterConfig, args) -> int:
+    """Delta rebuild (``--delta-from OLD_INDEX --diff FUSED``): old
+    index + fused diff epoch → a new epoch-tagged index bit-identical
+    to a from-scratch build on the retimed graph, recomputing only the
+    rows the changed edges can affect (``models.cpd.delta_build_index``
+    — untouched blocks byte-copy with their journaled digests). Exit 0
+    on success, 4 when the old index is unusable."""
+    from ..data.graph import Graph
+    from ..models.cpd import delta_build_index, read_manifest
+    from ..parallel.partition import DistributionController
+
+    if not args.diff:
+        log.error("--delta-from needs the fused diff file (--diff)")
+        return 2
+    # honor the old manifest's block_size/replication like --verify (a
+    # worker.build --block-size index delta-rebuilds consistently)
+    dc_kw = {}
+    try:
+        man = read_manifest(args.delta_from)
+        bs = int(man.get("block_size", 0))
+        if bs > 0:
+            dc_kw["block_size"] = bs
+        repl = int(man.get("replication", 1))
+        if repl > 1:
+            dc_kw["replication"] = repl
+    except (OSError, ValueError) as e:
+        log.error("delta fatal: no readable manifest in %s: %s",
+                  args.delta_from, e)
+        print(json.dumps({"index": args.delta_from, "exit_code": 4,
+                          "fatal": str(e)}))
+        return 4
+    graph = Graph.from_xy(conf.xy_file)
+    dc = DistributionController(conf.partmethod, conf.partkey,
+                                conf.maxworker, graph.n, **dc_kw)
+    report = delta_build_index(
+        graph, dc, args.delta_from, args.diff,
+        epoch=getattr(args, "delta_epoch", None), chunk=args.chunk,
+        resume=not getattr(args, "no_resume", False))
+    print(json.dumps({"exit_code": 0, **report}))
+    return 0
+
+
 def run_tpu(conf: ClusterConfig, args) -> None:
     """In-process sharded build over the mesh."""
     from ..parallel.multihost import initialize_from_conf
@@ -242,6 +288,8 @@ def main(argv=None) -> int:
         conf = ClusterConfig.load(args.c)
     if getattr(args, "verify", False):
         return run_verify(conf)
+    if getattr(args, "delta_from", None):
+        return run_delta(conf, args)
     if args.backend == "tpu" or (args.backend == "auto" and conf.is_tpu):
         run_tpu(conf, args)
     else:

@@ -30,6 +30,10 @@ import glob
 import io
 import json
 import os
+import queue
+import re
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -51,8 +55,9 @@ from ..parallel.sharded import (
 )
 from ..testing import faults
 from ..utils.atomicio import (
-    SWEEP_MIN_AGE_S, TMP_SUFFIX, atomic_save_npy, atomic_write_json,
-    digest_bytes, digest_file, quarantine,
+    SWEEP_MIN_AGE_S, TMP_SUFFIX, AtomicNpyWriter, atomic_copy_file,
+    atomic_save_npy, atomic_write_json, digest_bytes, digest_file,
+    quarantine,
 )
 from ..utils.env import env_cast, env_flag
 from ..utils.log import get_logger
@@ -92,6 +97,32 @@ M_BLOCKS_ADOPTED = obs_metrics.counter(
     "blocks digest-verified (healing as needed) by a worker adopting "
     "shard ownership during a membership reconfiguration")
 
+# build-pipeline + delta-build series: the throughput plane of the
+# road-scale build (ROADMAP item 1) — staging overlap, pipeline stalls,
+# and how much work an epoch-keyed delta rebuild actually skipped
+M_ROWS_STAGED = obs_metrics.counter(
+    "build_rows_staged_total",
+    "CPD build rows whose frontier/target inputs the host stager "
+    "prepared (pipelined and serial builds both count)")
+M_STAGE_OVERLAP = obs_metrics.histogram(
+    "build_stage_overlap_seconds",
+    "host-side staging time per block (target pad + device upload + "
+    "pre-opened block writer); overlapped with device compute when "
+    "the pipeline is on — overlap WON, so more is better")
+M_PIPE_STALL = obs_metrics.histogram(
+    "build_pipeline_stall_seconds",
+    "time the build's device-dispatch loop waited for the host stager "
+    "(pipelined builds only; the number the async stager exists to "
+    "drive to zero)")
+M_DELTA_ROWS = obs_metrics.counter(
+    "build_delta_rows_recomputed_total",
+    "rows a delta rebuild recomputed because the changed-edge pass "
+    "marked their first-move entries dirty")
+M_DELTA_SKIPPED = obs_metrics.counter(
+    "build_delta_skipped_blocks_total",
+    "blocks a delta rebuild reused (byte copy from the old index, "
+    "digest journaled) instead of recomputing")
+
 #: compressed device->host fm fetch below this raw size is not worth the
 #: extra device round trip (the count pass) — plain fetch instead
 FETCH_RLE_MIN_BYTES = 16 << 20
@@ -111,8 +142,7 @@ def _fm_run_count(fm: jnp.ndarray) -> jnp.ndarray:
     return ch.sum()
 
 
-@functools.partial(jax.jit, static_argnames=("cap",))
-def _fm_rle_encode(fm: jnp.ndarray, cap: int):
+def _fm_rle_encode_impl(fm: jnp.ndarray, cap: int):
     """Device-side transposed RLE of a [C, N] fm block ->
     ``(lens uint16 [cap], vals int8 [cap])`` in column-major run order
     (pads: length 0). Runs break at column boundaries, so a run never
@@ -130,13 +160,25 @@ def _fm_rle_encode(fm: jnp.ndarray, cap: int):
     return (nxt - idx).astype(jnp.uint16), vals
 
 
+_fm_rle_encode = functools.partial(
+    jax.jit, static_argnames=("cap",))(_fm_rle_encode_impl)
+#: donating variant for the pipelined build: the encode is the LAST
+#: consumer of a block's fm buffer, and donating it releases that HBM
+#: immediately instead of holding it live under the next block's kernels
+#: (real backends only — CPU donation is unimplemented and would warn
+#: per call; selection in fetch_fm)
+_fm_rle_encode_donate = functools.partial(
+    jax.jit, static_argnames=("cap",),
+    donate_argnums=(0,))(_fm_rle_encode_impl)
+
+
 def _fetch_rle_eligible(shape) -> bool:
     c, n = shape
     return (env_flag("DOS_FETCH_RLE", True) and c >= 2
             and c <= 65535 and c * n >= FETCH_RLE_MIN_BYTES)
 
 
-def fetch_fm(dev, count_dev=None) -> np.ndarray:
+def fetch_fm(dev, count_dev=None, donate: bool = False) -> np.ndarray:
     """Device [C, N] int8 fm block -> host numpy, RLE-compressed over
     the wire when it pays.
 
@@ -152,7 +194,15 @@ def fetch_fm(dev, count_dev=None) -> np.ndarray:
     dispatched EAGERLY when the block was computed — pipelined callers
     (``build_worker_shard``) enqueue it right behind the build kernel
     so this fetch never waits on later-dispatched device work for the
-    count."""
+    count.
+
+    ``donate=True`` (build callers that never touch ``dev`` again):
+    the RLE encode — this buffer's last consumer — DONATES it on real
+    backends, so a drained block's fm HBM frees under the next block's
+    kernels instead of doubling the pipeline's working set. The
+    default keeps the caller's buffer valid: donation is the caller's
+    decision, never a buried env check that invalidates someone
+    else's array."""
     c, n = dev.shape
     if not _fetch_rle_eligible((c, n)):
         return np.asarray(dev)
@@ -160,7 +210,10 @@ def fetch_fm(dev, count_dev=None) -> np.ndarray:
     cap = 1 << max(n_runs - 1, 0).bit_length()
     if 3 * cap >= c * n:          # incompressible: plain wins
         return np.asarray(dev)
-    lens, vals = _fm_rle_encode(dev, cap)
+    enc = (_fm_rle_encode_donate
+           if donate and jax.default_backend() != "cpu"
+           else _fm_rle_encode)
+    lens, vals = enc(dev, cap)
     lens_h, vals_h = jax.device_get((lens, vals))
     flat = np.repeat(vals_h[:n_runs], lens_h[:n_runs].astype(np.int64))
     return np.ascontiguousarray(flat.reshape(n, c).T)
@@ -248,13 +301,43 @@ class BuildLedger:
             pass
         return out
 
-    def record(self, fname: str, digest: str, shape, dtype: str) -> None:
-        line = json.dumps({"file": fname, "digest": digest,
-                           "shape": list(shape), "dtype": dtype})
+    def record(self, fname: str, digest: str, shape, dtype: str,
+               epoch: int | None = None) -> None:
+        """Journal one completed block. ``epoch`` keys the line to a
+        diff-epoch build (delta rebuilds and their full-degrade path):
+        readers that resume an epoch-keyed build treat entries from any
+        OTHER epoch as invalid — epoch-keyed block invalidation — while
+        legacy readers simply ignore the unknown key (the codec
+        contract)."""
+        ent = {"file": fname, "digest": digest,
+               "shape": list(shape), "dtype": dtype}
+        if epoch is not None:
+            ent["epoch"] = int(epoch)
+        line = json.dumps(ent)
         with open(self.path, "a") as f:
             f.write(line + "\n")
             f.flush()
             os.fsync(f.fileno())
+
+
+def _block_done(outdir: str, fname: str, entries: dict[str, dict],
+                epoch: int | None) -> bool:
+    """Resume check with epoch-keyed invalidation: a plain build
+    (``epoch=None``) keeps :func:`block_complete`'s rules (legacy
+    un-ledgered blocks accepted if they parse); an epoch-keyed build
+    requires a ledger line carrying THAT epoch with a matching on-disk
+    digest — a parseable block from another weight regime must never be
+    skipped into the new index."""
+    if epoch is None:
+        return block_complete(outdir, fname, entries)
+    ent = entries.get(fname)
+    if ent is None or ent.get("epoch") != int(epoch):
+        return False
+    path = os.path.join(outdir, fname)
+    try:
+        return digest_file(path) == ent.get("digest")
+    except OSError:
+        return False
 
 
 def block_complete(outdir: str, fname: str,
@@ -389,10 +472,158 @@ def pick_build_kernel(graph: Graph, method: str = "auto"):
     return "shift", ShiftGraph(shifts, w_shift, nbr_left, w_left, graph.n)
 
 
+# ------------------------------------------------------- build pipeline
+
+def build_pipeline_enabled() -> bool:
+    """``DOS_BUILD_PIPELINE`` (default on): stage the next block's
+    inputs on a background thread while the device runs the current
+    one. Off = the serial reference loop (the parity smoke pins the
+    two bit-identical)."""
+    return env_flag("DOS_BUILD_PIPELINE", True)
+
+
+def build_stage_depth() -> int:
+    """``DOS_BUILD_STAGE_DEPTH`` (default 2): staged blocks the host
+    keeps prepared ahead of the device — each holds its padded target
+    uploads and a pre-opened block writer, so depth is bounded host
+    memory, not correctness."""
+    return max(env_cast("DOS_BUILD_STAGE_DEPTH", 2, int), 1)
+
+
+def build_chunk_rows(graph: Graph, chunk: int, n_owned: int,
+                     kind: str = "ell") -> int:
+    """Rows per build kernel call. An explicit ``chunk`` wins; with
+    ``chunk=0`` and ``DOS_BUILD_HBM_MB`` set, the chunk is sized to
+    that HBM budget from the kernel's per-row working-set estimate —
+    multi-row frontier batching: the frontier/relax kernels amortize
+    their fixed per-dispatch cost (~0.3 ms loop floor + ~90 ms tunneled
+    sync) over as many source rows as the budget fits instead of
+    dispatching row by row. Power-of-two floored for stable compiled
+    shapes across shards; ``DOS_BUILD_HBM_MB`` unset keeps the legacy
+    whole-shard batch."""
+    if chunk > 0:
+        return chunk
+    budget_mb = env_cast("DOS_BUILD_HBM_MB", 0.0, float)
+    if budget_mb <= 0:
+        return max(n_owned, 1)
+    k = max(graph.max_out_degree, 1)
+    # dominant live arrays per target row: the dense gather's [N, K, B]
+    # relax temp (ell/ellsplit) or dist + temp + wake planes (~3x int32)
+    per_row = graph.n * ((k + 2) * 4 if kind in ("ell", "ellsplit")
+                         else 12)
+    rows = int(budget_mb * 1e6) // max(per_row, 1)
+    rows = max(min(rows, max(n_owned, 1)), 1)
+    return 1 << (int(rows).bit_length() - 1)
+
+
+def _make_chunk_compute(dg, kind: str, structure, max_iters: int):
+    """One dispatch closure per resolved build kernel: takes a padded
+    int32 target array (host or pre-uploaded device) and returns the
+    ASYNC device fm block plus its eagerly dispatched RLE run count —
+    the shared compute unit of the full build loop and the delta
+    rebuild's row splice."""
+    from ..ops import build_fm_columns
+    from ..ops.ell_split import build_fm_columns_ellsplit
+    from ..ops.frontier_relax import build_fm_columns_frontier
+    from ..ops.grid_sweep import build_fm_columns_sweep
+    from ..ops.shift_relax import build_fm_columns_shift
+
+    def compute_dev(pad):
+        if kind == "sweep":
+            return build_fm_columns_sweep(dg, structure, pad,
+                                          max_iters=max_iters)
+        if kind == "shift":
+            return build_fm_columns_shift(dg, structure, pad,
+                                          max_iters=max_iters)
+        if kind == "frontier":
+            return build_fm_columns_frontier(dg, structure, pad,
+                                             max_iters=max_iters)
+        if kind == "ellsplit":
+            return build_fm_columns_ellsplit(dg, structure, pad,
+                                             max_iters=max_iters)
+        return build_fm_columns(dg, jnp.asarray(pad),
+                                max_iters=max_iters)
+
+    def compute_with_count(pad):
+        d = compute_dev(pad)
+        cd = (_fm_run_count(d) if _fetch_rle_eligible(d.shape)
+              else None)
+        return d, cd
+
+    return compute_with_count
+
+
+class _BackgroundStager:
+    """Bounded-depth background staging thread of the pipelined build:
+    prepares block b+1's inputs (padded targets, device upload, the
+    pre-opened atomic block writer) while the device runs block b.
+    Iterating yields the staged items in order; the queue wait is the
+    pipeline stall the stager exists to hide
+    (``build_pipeline_stall_seconds``). ``close()`` stops the thread
+    and aborts every staged-but-unconsumed writer, so error paths
+    leave no tmp debris behind."""
+
+    def __init__(self, bids, stage_fn, depth: int, wid: int):
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(list(bids), stage_fn),
+            name=f"dos-build-stager-w{wid}", daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Stop-aware bounded put; False when close() raced it."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, bids, stage_fn) -> None:
+        try:
+            for bid in bids:
+                if self._stop.is_set():
+                    return
+                item = stage_fn(bid)
+                if not self._put(("item", item)):
+                    item[-1].abort()      # writer never reaches the loop
+                    return
+        except BaseException as e:  # noqa: BLE001 — carried to the
+            # consuming build loop, which re-raises it in caller context
+            self._put(("err", e))
+            return
+        self._put(("done", None))
+
+    def __iter__(self):
+        while True:
+            t0 = time.perf_counter()
+            kind, val = self._q.get()
+            M_PIPE_STALL.observe(time.perf_counter() - t0)
+            if kind == "done":
+                return
+            if kind == "err":
+                raise val
+            yield val
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        while True:
+            try:
+                kind, val = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if kind == "item":
+                val[-1].abort()
+
+
 def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
                        outdir: str, chunk: int = 0, max_iters: int = 0,
                        resume: bool = True,
-                       method: str = "auto", replica: int = 0) -> list[str]:
+                       method: str = "auto", replica: int = 0,
+                       epoch: int | None = None) -> list[str]:
     """Build and persist ONE worker's CPD block files on the local device.
 
     This is the host-mode build unit: the reference launches one
@@ -415,13 +646,27 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     primary; callers that have a digest-valid primary on the same
     filesystem should prefer :func:`copy_replica_blocks` first and let
     this recompute only what could not be copied.
-    """
-    from ..ops import build_fm_columns
-    from ..ops.ell_split import build_fm_columns_ellsplit
-    from ..ops.frontier_relax import build_fm_columns_frontier
-    from ..ops.grid_sweep import build_fm_columns_sweep
-    from ..ops.shift_relax import build_fm_columns_shift
 
+    The loop is a SOFTWARE PIPELINE (``DOS_BUILD_PIPELINE``, default
+    on): a host-side stager thread prepares the NEXT block's padded
+    target inputs — device upload included — and pre-opens its atomic
+    block writer while the device runs the CURRENT block's kernels and
+    the main thread drains/writes the PREVIOUS one; the fm fetch
+    donates its buffer into the RLE encode on real backends so a
+    drained block's HBM frees under the next block's compute. Results
+    are bit-identical to the serial loop (the ``build`` parity smoke
+    pins it): staging changes WHEN inputs are prepared, never what the
+    kernels compute. ``chunk=0`` with ``DOS_BUILD_HBM_MB`` set sizes
+    the per-kernel-call row batch to that HBM budget
+    (:func:`build_chunk_rows`).
+
+    ``epoch``: key this build's ledger lines to a diff epoch (delta
+    rebuilds): on resume, only blocks journaled under the SAME epoch
+    with a matching digest are skipped — a parseable block from
+    another weight regime is invalidated, not adopted. Callers that
+    TIME the build (bench) pass ``resume=False`` so no journal parse
+    lands inside the measured region.
+    """
     os.makedirs(outdir, exist_ok=True)
     # sweep THIS worker's atomic-write debris from a killed build; the
     # dir-wide sweep belongs to the campaign/launcher (other workers may
@@ -430,8 +675,7 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     # by a concurrent same-wid process (a respawned worker healing while
     # its hung predecessor still drains) — deleting it would turn that
     # process's rename into a crash
-    import time as _time
-    now = _time.time()
+    now = time.time()
     tmp_stem = (f"cpd-w{wid:05d}-r{replica:02d}-b*" if replica
                 else f"cpd-w{wid:05d}-b*")
     for p in glob.glob(os.path.join(
@@ -443,10 +687,6 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
             pass
     owned = dc.owned(wid)
     bs = dc.block_size
-    # compute granularity (device working set) is independent of the file
-    # granularity: each block file is assembled from `chunk`-row kernel
-    # calls, so a 16k-row block never forces a 16k-row device batch
-    chunk = chunk if chunk > 0 else max(len(owned), 1)
     n_blocks = (len(owned) + bs - 1) // bs
     # only the missing blocks are computed — a restart after a partial
     # build pays exactly for what is not yet on disk, and "on disk"
@@ -455,8 +695,9 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     entries = ledger.entries() if resume else {}
     missing, resumed = [], 0
     for bid in range(n_blocks):
-        if resume and block_complete(
-                outdir, shard_block_name(wid, bid, replica), entries):
+        if resume and _block_done(
+                outdir, shard_block_name(wid, bid, replica), entries,
+                epoch):
             resumed += 1
         else:
             missing.append(bid)
@@ -468,29 +709,39 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         return []
     kind, structure = pick_build_kernel(graph, method)
     dg = DeviceGraph.from_graph(graph)
+    # compute granularity (device working set) is independent of the
+    # file granularity: each block file is assembled from `chunk`-row
+    # kernel calls, so a 16k-row block never forces a 16k-row device
+    # batch; with DOS_BUILD_HBM_MB set the chunk is budget-sized
+    chunk = build_chunk_rows(graph, chunk, len(owned), kind=kind)
+    compute_with_count = _make_chunk_compute(dg, kind, structure,
+                                             max_iters)
+    # this build never touches a drained block's device buffers again,
+    # so the fetch may donate them into the encode (DOS_BUILD_DONATE)
+    donate = env_flag("DOS_BUILD_DONATE", True)
 
-    def compute_dev(tgts: np.ndarray):
-        """Dispatch one chunk's kernel; returns the DEVICE array (async —
-        the fetch happens one block behind, so the device computes block
-        b+1 while the host drains and writes block b)."""
-        pad = np.full(chunk, -1, np.int32)  # fixed shape -> one compile
-        pad[:len(tgts)] = tgts
-        if kind == "sweep":
-            return build_fm_columns_sweep(dg, structure, pad,
-                                          max_iters=max_iters)
-        if kind == "shift":
-            return build_fm_columns_shift(dg, structure, pad,
-                                          max_iters=max_iters)
-        if kind == "frontier":
-            return build_fm_columns_frontier(dg, structure, pad,
-                                             max_iters=max_iters)
-        if kind == "ellsplit":
-            return build_fm_columns_ellsplit(dg, structure, pad,
-                                             max_iters=max_iters)
-        return build_fm_columns(dg, jnp.asarray(pad), max_iters=max_iters)
+    def stage(bid: int):
+        """Host-side prep of ONE block: padded target arrays uploaded
+        to device (the H2D transfer overlaps the previous block's
+        kernels under the pipeline) and the block's atomic writer
+        pre-opened — all of it off the device-dispatch critical path."""
+        t0 = time.perf_counter()
+        blk = owned[bid * bs: min((bid + 1) * bs, len(owned))]
+        lens, pads = [], []
+        for i in range(0, len(blk), chunk):
+            part = blk[i:i + chunk]
+            pad = np.full(chunk, -1, np.int32)  # fixed shape -> 1 compile
+            pad[:len(part)] = part
+            pads.append(jax.device_put(pad))
+            lens.append(len(part))
+        fname = shard_block_name(wid, bid, replica)
+        writer = AtomicNpyWriter(os.path.join(outdir, fname))
+        M_ROWS_STAGED.inc(int(len(blk)))
+        M_STAGE_OVERLAP.observe(time.perf_counter() - t0)
+        return (bid, fname, lens, pads, writer)
 
     def flush(entry) -> None:
-        bid, lens, devs = entry
+        bid, fname, lens, devs, writer = entry
         # RLE-compressed fetch per chunk (plain for small blocks): the
         # build is link-bound on tunneled devices, and fm compresses
         # 5-15x over the target axis (see fetch_fm). Run counts were
@@ -500,16 +751,18 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         # the seconds of raw drain it replaces — per block the cost is
         # ~max(compute, tiny drain) either way on a fast link, and
         # compute-bound instead of drain-bound on a slow one.
-        parts = [fetch_fm(d, count_dev=cd) for d, cd in devs]
+        parts = [fetch_fm(d, count_dev=cd, donate=donate)
+                 for d, cd in devs]
         trimmed = [p[:ln] for p, ln in zip(parts, lens)]
         arr = (trimmed[0] if len(trimmed) == 1
                else np.concatenate(trimmed))
-        fname = shard_block_name(wid, bid, replica)
-        # atomic write, then the ledger line: a kill between the two
-        # leaves a complete un-journaled file (the legacy-parse resume
-        # path accepts it); a kill MID-write leaves only tmp debris
-        digest = atomic_save_npy(os.path.join(outdir, fname), arr)
-        ledger.record(fname, digest, arr.shape, str(arr.dtype))
+        # atomic write (into the pre-opened tmp), then the ledger line:
+        # a kill between the two leaves a complete un-journaled file
+        # (the legacy-parse resume path accepts it); a kill MID-write
+        # leaves only tmp debris
+        digest = writer.commit(arr)
+        ledger.record(fname, digest, arr.shape, str(arr.dtype),
+                      epoch=epoch)
         # chaos hook: DOS_FAULTS="crash-build;..." dies here, between
         # block flushes — the kill-mid-build resume test's trigger
         rule = faults.inject("crash-build", wid=wid)
@@ -518,26 +771,450 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
                 os._exit(faults.KILL_EXIT_CODE)
             raise RuntimeError("crash-build fault injected")
 
-    def compute_with_count(tgts: np.ndarray):
-        d = compute_dev(tgts)
-        cd = (_fm_run_count(d) if _fetch_rle_eligible(d.shape)
-              else None)
-        return d, cd
-
+    pipelined = build_pipeline_enabled() and len(missing) > 1
+    stager = (_BackgroundStager(missing, stage, build_stage_depth(), wid)
+              if pipelined else None)
+    staged_iter = iter(stager) if stager is not None \
+        else (stage(bid) for bid in missing)
     written = []
     pending = None                          # one block in flight
-    for bid in missing:
-        blk = owned[bid * bs: min((bid + 1) * bs, len(owned))]
-        lens = [len(blk[i:i + chunk]) for i in range(0, len(blk), chunk)]
-        devs = [compute_with_count(blk[i:i + chunk])
-                for i in range(0, len(blk), chunk)]
+    try:
+        for item in staged_iter:
+            try:
+                devs = [compute_with_count(p) for p in item[3]]
+                if pending is not None:
+                    flush(pending)
+            except BaseException:
+                item[4].abort()         # staged writer never flushed
+                raise
+            pending = (item[0], item[1], item[2], devs, item[4])
+            written.append(item[1])
         if pending is not None:
             flush(pending)
-        pending = (bid, lens, devs)
-        written.append(shard_block_name(wid, bid, replica))
-    if pending is not None:
-        flush(pending)
+            pending = None
+    finally:
+        if pending is not None:
+            pending[4].abort()              # error path: no tmp debris
+        if stager is not None:
+            stager.close()
     return written
+
+
+# --------------------------------------------------------- delta builds
+
+def epoch_index_dir(outdir: str, epoch: int) -> str:
+    """Where a delta rebuild for diff epoch ``epoch`` materializes: a
+    sibling-free SUBDIR of the base index, so the epoch-swap machinery
+    (worker promotion, the retime→rebuild hook) can find every epoch's
+    index from the one path it already knows."""
+    return os.path.join(outdir, f"epoch-e{int(epoch):06d}")
+
+
+def diff_epoch_of(difffile: str) -> int | None:
+    """Diff epoch encoded in a fused-diff file name
+    (``fused-e<epoch>.diff``, the DiffEpochManager spool convention);
+    None for names that don't carry one."""
+    m = re.search(r"-e(\d+)\.diff$", os.path.basename(difffile or ""))
+    return int(m.group(1)) if m else None
+
+
+def delta_affected_targets(graph: Graph, changed_eids: np.ndarray,
+                           w_old: np.ndarray, w_new: np.ndarray,
+                           max_seeds: int | None = None,
+                           seed_chunk: int = 512) -> np.ndarray | None:
+    """Target rows whose first-move entries CAN change when the named
+    edges change weight — the delta build's dirty set.
+
+    The test is the classic tense-edge criterion run as one bounded
+    reverse-relaxation pass: compute ``d_old(e → t)`` for every changed
+    edge endpoint ``e`` (a batched relaxation on the TRANSPOSED graph —
+    the reverse-reachability pass, B = endpoints, not N), then mark
+    target ``t`` dirty iff some changed edge ``(u, v)`` satisfies
+    ``min(w_old, w_new)(u,v) + d_old(v→t) <= d_old(u→t)``. For an
+    INCREASE that condition (with ``w_old``) holds exactly when the
+    edge lies on a co-optimal path into ``t`` — otherwise neither
+    distances nor any argmin input within row ``t`` move; for a
+    DECREASE it (with ``w_new``) holds exactly when the cheaper edge
+    becomes tense — otherwise it still strictly loses everywhere. ``<=``
+    (not ``<``) keeps argmin TIES dirty, which is what makes a spliced
+    delta rebuild bit-identical to a from-scratch build. Unreachable
+    ``d_old(v→t) = INF`` rows stay clean: weight changes never create
+    reachability.
+
+    Returns the sorted dirty target ids, or ``None`` when the changed
+    edge set exceeds the ``max_seeds`` bound
+    (``DOS_BUILD_DELTA_MAX_SEEDS``; <= 0 = unbounded) — the caller then
+    degrades to a full rebuild, the conservative answer.
+    """
+    from ..ops.bellman_ford import dist_to_targets
+
+    changed_eids = np.asarray(changed_eids, np.int64)
+    if len(changed_eids) == 0:
+        return np.zeros(0, np.int64)
+    ends_all = np.unique(np.concatenate(
+        [graph.src[changed_eids], graph.dst[changed_eids]]))
+    if max_seeds is None:
+        max_seeds = env_cast("DOS_BUILD_DELTA_MAX_SEEDS", 4096, int)
+    if max_seeds > 0 and len(ends_all) > max_seeds:
+        log.info("delta pass: %d changed-edge endpoints exceed the "
+                 "DOS_BUILD_DELTA_MAX_SEEDS=%d bound; degrading to a "
+                 "full rebuild", len(ends_all), max_seeds)
+        return None
+    # transposed graph under OLD weights: dist_to_targets(gT, e) gives
+    # d_T(x -> e) = d_old(e -> x) for every node x in one [B, N] solve
+    g_t = Graph(graph.xs, graph.ys, graph.dst, graph.src, w_old)
+    dg_t = DeviceGraph.from_graph(g_t)
+    minw = np.minimum(np.asarray(w_old, np.int64)[changed_eids],
+                      np.asarray(w_new, np.int64)[changed_eids])
+    inf64 = int(INF)
+    dirty = np.zeros(graph.n, bool)
+    per = max(seed_chunk // 2, 1)
+    for i in range(0, len(changed_eids), per):
+        eids = changed_eids[i:i + per]
+        eu = graph.src[eids]
+        ev = graph.dst[eids]
+        ends = np.unique(np.concatenate([eu, ev]))
+        # pad to the pow2 of the ACTUAL endpoint count (capped at the
+        # chunk): a 10-edge hotspot must pay a 16-wide solve, not a
+        # 512-wide one — the pass's cost tracks the delta's size
+        csize = min(seed_chunk,
+                    1 << (max(len(ends), 1) - 1).bit_length())
+        pad = np.full(csize, -1, np.int32)
+        pad[:len(ends)] = ends
+        d = np.asarray(dist_to_targets(
+            dg_t, jnp.asarray(pad))).astype(np.int64)   # [B, N]
+        du = d[np.searchsorted(ends, eu)]
+        dv = d[np.searchsorted(ends, ev)]
+        tense = (dv < inf64) & (minw[i:i + per][:, None] + dv <= du)
+        dirty |= tense.any(axis=0)
+    return np.nonzero(dirty)[0].astype(np.int64)
+
+
+def _compute_rows_batched(compute_with_count, tgts: np.ndarray,
+                          chunk_rows: int) -> np.ndarray:
+    """Solve fm rows for an arbitrary target list in chunk batches —
+    the shared recompute unit of the delta paths. Full batches reuse
+    the chunk's compiled shape; the final partial batch pads to its
+    own pow2 (capped at the chunk) so a handful of dirty rows never
+    pays a whole-chunk solve."""
+    donate = env_flag("DOS_BUILD_DONATE", True)
+    parts = []
+    for i in range(0, len(tgts), chunk_rows):
+        part = tgts[i:i + chunk_rows]
+        csize = min(chunk_rows,
+                    1 << (max(len(part), 1) - 1).bit_length())
+        pad = np.full(csize, -1, np.int32)
+        pad[:len(part)] = part
+        d, cd = compute_with_count(pad)
+        parts.append(fetch_fm(d, count_dev=cd,
+                              donate=donate)[:len(part)])
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
+def _delta_compute_ctx(ctx: dict | None, graph_new: Graph,
+                       method: str, max_iters: int) -> dict:
+    """Lazily resolved per-DELTA compute context: the build kernel
+    choice, the device-resident graph, and the dispatch closure are
+    identical across every shard of one delta, so an in-process
+    multi-shard driver (``delta_build_index``) shares ONE DeviceGraph
+    upload instead of re-uploading the CSR arrays per shard. ``ctx``
+    is the shared mutable cache (``None`` = private, standalone
+    callers); a delta where every block copies never populates it."""
+    if ctx is None:
+        ctx = {}
+    if "compute" not in ctx:
+        kind, structure = pick_build_kernel(graph_new, method)
+        dg = DeviceGraph.from_graph(graph_new)
+        ctx["kind"] = kind
+        ctx["compute"] = _make_chunk_compute(dg, kind, structure,
+                                             max_iters)
+    return ctx
+
+
+def delta_build_worker_shard(graph_new: Graph, dc: DistributionController,
+                             wid: int, old_outdir: str, outdir: str,
+                             dirty: np.ndarray | None,
+                             old_blocks_meta: dict | None = None,
+                             chunk: int = 0, max_iters: int = 0,
+                             resume: bool = True, method: str = "auto",
+                             epoch: int = 0,
+                             compute_ctx: dict | None = None) -> dict:
+    """One worker's shard of a DELTA rebuild: blocks with no dirty row
+    are byte-copied from the old index (digest journaled, zero device
+    work), dirty blocks recompute ONLY their dirty rows on the retimed
+    graph and splice them into the old block's clean rows. ``dirty`` is
+    the [N] bool mask from :func:`delta_affected_targets`; ``None`` (or
+    a dirty fraction above ``DOS_BUILD_DELTA_MAX_FRAC``) degrades the
+    whole shard to a pipelined full rebuild — whole-shard-dirty is the
+    regime where splicing only adds overhead. Every block lands through
+    the same atomic write + epoch-keyed ledger line as a full build, so
+    a crash mid-delta resumes at block granularity and a stale-epoch
+    journal never satisfies the resume check."""
+    os.makedirs(outdir, exist_ok=True)
+    owned = dc.owned(wid)
+    bs = dc.block_size
+    n_blocks = (len(owned) + bs - 1) // bs
+    report = {"blocks": n_blocks, "rows_recomputed": 0,
+              "blocks_skipped": 0, "blocks_resumed": 0,
+              "degraded_full": False}
+    dirty_owned = (np.ones(len(owned), bool) if dirty is None
+                   else np.asarray(dirty, bool)[owned])
+    max_frac = env_cast("DOS_BUILD_DELTA_MAX_FRAC", 0.75, float)
+    if dirty is None or (len(owned)
+                         and dirty_owned.mean() > max_frac):
+        written = build_worker_shard(graph_new, dc, wid, outdir,
+                                     chunk=chunk, max_iters=max_iters,
+                                     resume=resume, method=method,
+                                     epoch=epoch)
+        report["degraded_full"] = True
+        report["rows_recomputed"] = int(
+            min(len(written) * bs, len(owned)))
+        M_DELTA_ROWS.inc(report["rows_recomputed"])
+        return report
+    ledger = BuildLedger(outdir, wid)
+    entries = ledger.entries() if resume else {}
+    old_blocks_meta = old_blocks_meta or {}
+
+    def crash_point() -> None:
+        rule = faults.inject("crash-build", wid=wid)
+        if rule is not None:
+            if rule.mode == "exit":
+                os._exit(faults.KILL_EXIT_CODE)
+            raise RuntimeError("crash-build fault injected")
+
+    # pass 1 — classify every block (resume / byte-copy / rebuild) and
+    # collect the rebuild blocks' dirty targets, so pass 2 can solve
+    # them in SHARD-WIDE chunk batches: per-block solves would shatter
+    # the multi-row batching (and its compiled-shape reuse) that makes
+    # the kernels fast — the same amortization the full build lives
+    # on. Old rows are NOT retained here (only the verify status):
+    # pass 2 re-reads each dirty block as it lands, bounding host
+    # memory to the recompute batch plus ONE block instead of every
+    # dirty block's copy at once.
+    todo: list[tuple] = []        # (bid, fname, blk, bmask, old_ok)
+    recompute_tgts: list[np.ndarray] = []
+    for bid in range(n_blocks):
+        fname = shard_block_name(wid, bid)
+        if resume and _block_done(outdir, fname, entries, epoch):
+            report["blocks_resumed"] += 1
+            M_BLOCKS_RESUMED.inc()
+            continue
+        lo, hi = bid * bs, min((bid + 1) * bs, len(owned))
+        blk = owned[lo:hi]
+        bmask = dirty_owned[lo:hi].copy()
+        old_path = os.path.join(old_outdir, fname)
+        old_meta = old_blocks_meta.get(fname)
+        if not bmask.any():
+            todo.append((bid, fname, blk, None, False))  # byte copy
+            continue
+        status, reason = check_block(old_path, old_meta)
+        old_ok = status in ("ok", "unverified")
+        if not old_ok:
+            if status != "missing":
+                log.warning("delta rebuild of %s: old block is %s "
+                            "(%s); recomputing every row", fname,
+                            status, reason)
+            bmask[:] = True          # no clean base to splice into
+        todo.append((bid, fname, blk, bmask, old_ok))
+        recompute_tgts.append(blk[bmask])
+
+    rows_new = None
+    if recompute_tgts:
+        tgts_all = np.concatenate(recompute_tgts)
+        compute_ctx = _delta_compute_ctx(compute_ctx, graph_new,
+                                         method, max_iters)
+        chunk_rows = build_chunk_rows(graph_new, chunk, len(owned),
+                                      kind=compute_ctx["kind"])
+        rows_new = _compute_rows_batched(compute_ctx["compute"],
+                                         tgts_all, chunk_rows)
+
+    # pass 2 — land blocks in bid order through the same atomic write +
+    # epoch-keyed ledger discipline as a full build (crash-build fires
+    # between flushes, so mid-delta kills resume at block granularity)
+    off = 0
+    for bid, fname, blk, bmask, old_ok in todo:
+        old_path = os.path.join(old_outdir, fname)
+        old_meta = old_blocks_meta.get(fname)
+        if bmask is None:
+            # clean block: byte copy, digest cross-checked against the
+            # old manifest — a MISSING source (quarantined, swept) or a
+            # torn one recomputes instead of aborting the shard or
+            # propagating rot into the new epoch
+            try:
+                digest = atomic_copy_file(old_path,
+                                          os.path.join(outdir, fname))
+            except OSError as e:
+                log.warning("delta copy of %s failed (%s); "
+                            "recomputing", fname, e)
+                digest = None
+            if digest is None or (old_meta and old_meta.get("digest")
+                                  and digest != old_meta["digest"]):
+                if digest is not None:
+                    log.warning("delta copy of %s does not match the "
+                                "old manifest digest (%s != %s); "
+                                "recomputing", fname, digest,
+                                old_meta["digest"])
+                arr = _delta_single_block(graph_new, blk, chunk,
+                                          len(owned), method, max_iters,
+                                          compute_ctx)
+                n_new = len(blk)
+            else:
+                arr = np.load(os.path.join(outdir, fname),
+                              mmap_mode="r")
+                ledger.record(fname, digest, arr.shape,
+                              str(arr.dtype), epoch=epoch)
+                report["blocks_skipped"] += 1
+                M_DELTA_SKIPPED.inc()
+                crash_point()
+                continue
+        else:
+            n_new = int(bmask.sum())
+            fresh = rows_new[off:off + n_new]
+            off += n_new
+            if not old_ok:
+                arr = fresh          # bmask was forced all-dirty
+            else:
+                # old rows re-read HERE, one block at a time (pass 1
+                # kept only the verify status) — bounded host memory
+                rows_old, status, reason = load_verified_block(
+                    old_path, old_meta)
+                if rows_old is None:
+                    # vanished/torn between passes (rare race): the
+                    # batched fresh rows only cover bmask, so the
+                    # whole block recomputes
+                    log.warning("delta splice of %s: old block "
+                                "became %s between passes (%s); "
+                                "recomputing every row", fname,
+                                status, reason)
+                    arr = _delta_single_block(graph_new, blk, chunk,
+                                              len(owned), method,
+                                              max_iters, compute_ctx)
+                    n_new = len(blk)
+                else:
+                    arr = np.asarray(rows_old).copy()
+                    arr[bmask] = fresh
+        digest = atomic_save_npy(os.path.join(outdir, fname), arr)
+        ledger.record(fname, digest, arr.shape, str(arr.dtype),
+                      epoch=epoch)
+        report["rows_recomputed"] += n_new
+        M_DELTA_ROWS.inc(n_new)
+        crash_point()
+    return report
+
+
+def _delta_single_block(graph_new: Graph, blk: np.ndarray, chunk: int,
+                        n_owned: int, method: str, max_iters: int,
+                        compute_ctx: dict | None = None) -> np.ndarray:
+    """Recompute one whole block outside the shard-wide batch — the
+    rare torn-copy fallback path of :func:`delta_build_worker_shard`
+    (sharing the delta's compute context, so even this path never
+    re-uploads the device graph)."""
+    ctx = _delta_compute_ctx(compute_ctx, graph_new, method, max_iters)
+    chunk_rows = build_chunk_rows(graph_new, chunk, n_owned,
+                                  kind=ctx["kind"])
+    return _compute_rows_batched(ctx["compute"], blk, chunk_rows)
+
+
+def delta_build_index(graph: Graph, dc: DistributionController,
+                      old_outdir: str, difffile: str,
+                      epoch: int | None = None,
+                      out_root: str | None = None, chunk: int = 0,
+                      max_iters: int = 0, method: str = "auto",
+                      resume: bool = True, workers=None) -> dict:
+    """Delta rebuild: old index + a fused diff epoch → a NEW
+    epoch-tagged index (``epoch_index_dir``) bit-identical to a
+    from-scratch build on the retimed graph, recomputing only the rows
+    the changed edges can actually affect.
+
+    The changed edge set is ``w_new != w_old`` where ``w_old`` comes
+    from the old manifest's recorded ``diff_file`` (absent = free flow
+    — a plain build), so delta-on-delta chains compose. The affected
+    rows come from :func:`delta_affected_targets`; untouched blocks
+    byte-copy with their ledger/manifest digests reused. The resulting
+    index carries ``diff_epoch``/``diff_file`` manifest keys (unknown
+    to old readers — the codec contract) so the epoch-swap machinery
+    can promote it under a running serve
+    (``worker.engine.ShardEngine.promote_index``).
+    """
+    old_manifest = read_manifest(old_outdir)
+    check_manifest_version(old_manifest, old_outdir)
+    old_diff = old_manifest.get("diff_file", "-")
+    try:
+        w_old = graph.weights_with_diff(old_diff)
+    except OSError as e:
+        # the old index's fused diff was pruned from the spool (the
+        # DiffEpochManager keep window outlives only keep_epochs
+        # files): without it the changed-edge set is unknowable, so
+        # the delta DEGRADES to a full rebuild on the retimed graph —
+        # still a correct epoch index, never a failed chain link
+        log.warning("old index %s records diff_file %s which is "
+                    "unreadable (%s); delta degrades to a full "
+                    "rebuild", old_outdir, old_diff, e)
+        w_old = None
+    w_new = graph.weights_with_diff(difffile)
+    changed = (np.nonzero(w_new != w_old)[0] if w_old is not None
+               else np.zeros(0, np.int64))
+    if epoch is None:
+        epoch = diff_epoch_of(difffile)
+    if epoch is None:
+        epoch = int(old_manifest.get("diff_epoch", 0)) + 1
+    outdir = epoch_index_dir(out_root or old_outdir, int(epoch))
+    graph_new = Graph(graph.xs, graph.ys, graph.src, graph.dst, w_new)
+    if w_old is None:
+        dirty = None                          # unknown delta: full
+    elif len(changed) == 0:
+        dirty = np.zeros(graph.n, bool)       # empty delta: copy all
+    else:
+        affected = delta_affected_targets(graph, changed, w_old, w_new)
+        if affected is None:
+            dirty = None                      # degrade to full
+        else:
+            dirty = np.zeros(graph.n, bool)
+            dirty[affected] = True
+    report: dict = {
+        "epoch": int(epoch), "outdir": outdir,
+        "changed_edges": int(len(changed)),
+        "affected_rows": (int(graph.n) if dirty is None
+                          else int(dirty.sum())),
+        "rows_recomputed": 0, "blocks_skipped": 0,
+        "blocks_resumed": 0, "degraded_full": False, "shards": 0,
+    }
+    # one compute context for the WHOLE delta: kernel choice and the
+    # device-resident graph are shard-invariant, so the in-process
+    # multi-shard loop uploads the CSR arrays once, not per shard
+    ctx: dict = {}
+    with obs_trace.span("cpd.delta_build", epoch=int(epoch),
+                        changed=int(len(changed))):
+        for wid in (range(dc.maxworker) if workers is None else workers):
+            rep = delta_build_worker_shard(
+                graph_new, dc, wid, old_outdir, outdir, dirty,
+                old_blocks_meta=old_manifest.get("blocks", {}),
+                chunk=chunk, max_iters=max_iters, resume=resume,
+                method=method, epoch=int(epoch), compute_ctx=ctx)
+            report["shards"] += 1
+            report["rows_recomputed"] += rep["rows_recomputed"]
+            report["blocks_skipped"] += rep["blocks_skipped"]
+            report["blocks_resumed"] += rep["blocks_resumed"]
+            report["degraded_full"] |= rep["degraded_full"]
+        if workers is None and dc.replication > 1:
+            # replica sets copy from the NEW primaries in the same dir
+            for host in range(dc.maxworker):
+                for r in range(1, dc.replication):
+                    copy_replica_blocks(dc, (host - r) % dc.maxworker,
+                                        r, outdir, resume=resume)
+        if workers is None:
+            write_index_manifest(
+                outdir, dc,
+                rows_per_worker=old_manifest.get("rows_per_worker"),
+                extra={"diff_epoch": int(epoch),
+                       "diff_file": os.path.abspath(difffile)})
+    log.info("delta build epoch %d: %d changed edge(s) -> %d/%d rows "
+             "recomputed, %d block(s) copied%s -> %s", epoch,
+             report["changed_edges"], report["rows_recomputed"],
+             graph.n, report["blocks_skipped"],
+             " (degraded to full)" if report["degraded_full"] else "",
+             outdir)
+    return report
 
 
 def copy_replica_blocks(dc: DistributionController, shard: int,
@@ -627,7 +1304,7 @@ def _block_meta_for(outdir: str, fname: str,
 def write_index_manifest(outdir: str, dc: DistributionController,
                          rows_per_worker: int | None = None,
                          workers=None, block_meta: dict | None = None,
-                         ) -> dict:
+                         extra: dict | None = None) -> dict:
     """Write ``index.json`` describing a per-block CPD index (the head
     runs this after all workers' builds finish). Written atomically.
 
@@ -642,6 +1319,11 @@ def write_index_manifest(outdir: str, dc: DistributionController,
     index for single-worker serving (the analog of the reference's ``-w``
     filter): streamed/resident serving then answers only queries whose
     target those workers own; other workers' rows load as "stuck".
+
+    ``extra``: additional manifest keys (the delta build's
+    ``diff_epoch``/``diff_file`` tags) — unknown to older readers,
+    which tolerate them per the codec contract; callers must not shadow
+    the required partition keys.
     """
     files = []
     replica_files = []
@@ -689,6 +1371,8 @@ def write_index_manifest(outdir: str, dc: DistributionController,
         # index stays byte-identical to the pre-replication format
         manifest["replication"] = dc.replication
         manifest["replica_files"] = replica_files
+    if extra:
+        manifest.update(extra)
     atomic_write_json(os.path.join(outdir, "index.json"), manifest)
     return manifest
 

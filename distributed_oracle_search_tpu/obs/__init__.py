@@ -127,6 +127,19 @@ README's "Artifact durability & resume"):
 * crash-resume — ``build_blocks_resumed_total`` (blocks a restarted
   build skipped because the per-worker ledger records them complete
   with a matching on-disk digest);
+* build pipeline (``models.cpd.build_worker_shard`` — async
+  host→device staging) — ``build_rows_staged_total`` (rows whose
+  frontier/target inputs the host stager prepared),
+  ``build_stage_overlap_seconds`` (host staging time per block:
+  padded-target device upload + pre-opened block writer, overlapped
+  with device compute when the pipeline is on),
+  ``build_pipeline_stall_seconds`` (time the device-dispatch loop
+  waited on the stager — the number the pipeline drives toward zero);
+* delta rebuilds (``models.cpd.delta_build_index`` — epoch-keyed
+  incremental CPD refresh) — ``build_delta_rows_recomputed_total``
+  (rows the tense-edge pass marked dirty and the delta recomputed),
+  ``build_delta_skipped_blocks_total`` (blocks reused as byte copies
+  from the old index, digests journaled, zero device work);
 * sweep — ``artifacts_swept_total`` (stale ``*.tmp`` debris and
   leftover ``*.quarantined`` blocks removed at build/campaign start,
   the artifact-plane analog of ``head_stale_fifos_cleaned_total``).

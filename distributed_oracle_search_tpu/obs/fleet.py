@@ -290,6 +290,19 @@ _KEY_DIRECTIONS = {
     "walk_pallas_speedup": "higher",
     "walk_pallas_kernel_seconds": "lower",
     "walk_pallas_stall_p99_ms": "lower",
+    # the build family (pipelined + delta builds, ROADMAP item 1):
+    # build rates and the delta-vs-full ratio improve UP, pipeline
+    # stall improves DOWN — and staging OVERLAP improves UP despite
+    # its _seconds suffix (overlap won is host work hidden behind the
+    # device, exactly what the pipeline exists for), so it MUST be
+    # listed here or the suffix heuristic gates it backwards
+    "scale_build_rows_per_sec": "higher",
+    "road_tpu_build_rows_per_sec": "higher",
+    "build_delta_vs_full_ratio": "higher",
+    "build_full_rows_per_sec": "higher",
+    "build_delta_rows_per_sec": "higher",
+    "build_pipeline_stall_seconds": "lower",
+    "build_stage_overlap_seconds": "higher",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -301,6 +314,11 @@ _KEY_TOLERANCES = {
     "walk_pallas_useful_lane_fraction": 0.15,
     "walk_gather_utilization": 0.15,
     "walk_issue_efficiency": 0.15,
+    # the delta-vs-full ratio is a structural property of the dirty-set
+    # pass (work skipped / work done), not a raw device timing — a real
+    # drop means the pass stopped skipping, so gate it tighter than the
+    # jittery-link default
+    "build_delta_vs_full_ratio": 0.2,
 }
 
 

@@ -72,7 +72,8 @@ class DiffEpochManager:
                  keep_epochs: int | None = None,
                  scoped_max: int | None = None,
                  sig_moves: int | None = None,
-                 poll_ms: float | None = None):
+                 poll_ms: float | None = None,
+                 on_swap=None):
         if isinstance(stream, str):
             stream = DiffStream(stream)
         self.stream = stream
@@ -117,6 +118,14 @@ class DiffEpochManager:
         self.difffile = base_diff
         self._affected: frozenset = frozenset()
         self._applied = 0
+        #: retime→rebuild trigger hook: called AFTER a swap publishes,
+        #: outside the lock, as ``on_swap(epoch, difffile, affected)``
+        #: — the seam a delta-rebuild consumer registers on (kick
+        #: ``models.cpd.delta_build_index`` for the new weight regime
+        #: in the background, then promote the epoch-tagged index via
+        #: ``ShardEngine.promote_index``). A raising hook is logged and
+        #: never blocks or unwinds the swap itself.
+        self.on_swap = on_swap
 
     # ------------------------------------------------------------- views
     def active(self) -> tuple[int, str, frozenset]:
@@ -196,6 +205,13 @@ class DiffEpochManager:
         log.info("diff epoch %d active: %d segment(s) fused, %d edge(s) "
                  "changed -> %s", epoch, len(segs), len(affected),
                  difffile)
+        if self.on_swap is not None:
+            try:
+                self.on_swap(epoch, difffile, frozenset(affected))
+            except Exception as e:  # noqa: BLE001 — a rebuild trigger
+                # must never unwind a published swap; serving continues
+                log.error("on_swap hook failed for epoch %d: %s",
+                          epoch, e)
         self._prune_spool(epoch)
         return True
 

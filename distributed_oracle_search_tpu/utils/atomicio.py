@@ -156,6 +156,68 @@ def atomic_save_npy(path: str, arr: np.ndarray) -> str:
     return digest_bytes(data)
 
 
+class AtomicNpyWriter:
+    """Pre-openable atomic ``.npy`` block writer for the pipelined build.
+
+    Opening the temp file is metadata work (create, fd allocation —
+    on NFS a COMMIT round trip) that the build's host-side stager does
+    for the NEXT block while the device computes the CURRENT one;
+    :meth:`commit` then only pays payload write + fsync + rename.
+    Same discipline as :func:`atomic_write_bytes`: the final name never
+    names torn bytes. :meth:`abort` removes an un-committed temp file
+    (a staged block the build never reached)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._tmp = f"{path}{TMP_SUFFIX}.{os.getpid()}"
+        self._f = open(self._tmp, "wb")
+
+    def commit(self, arr: np.ndarray) -> str:
+        """Write + fsync + rename; returns the content digest."""
+        data = npy_bytes(arr)
+        try:
+            self._f.write(data)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        finally:
+            self._f.close()
+        os.rename(self._tmp, self.path)
+        _fsync_dir(os.path.dirname(self.path))
+        return digest_bytes(data)
+
+    def abort(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        try:
+            os.remove(self._tmp)
+        except OSError:
+            pass
+
+
+def atomic_copy_file(src: str, dst: str) -> str:
+    """Copy a file atomically (tmp + fsync + rename), returning the
+    digest of the copied bytes — the delta build's block-reuse path:
+    an untouched block moves old index → new epoch index as a streamed
+    byte copy, never a recompute, and the returned digest feeds the
+    new ledger/manifest without a read-back."""
+    tmp = f"{dst}{TMP_SUFFIX}.{os.getpid()}"
+    crc = 0
+    with open(src, "rb") as fin, open(tmp, "wb") as fout:
+        while True:
+            chunk = fin.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            fout.write(chunk)
+        fout.flush()
+        os.fsync(fout.fileno())
+    os.rename(tmp, dst)
+    _fsync_dir(os.path.dirname(dst))
+    return f"crc32:{crc & 0xFFFFFFFF:08x}"
+
+
 def quarantine(path: str) -> str | None:
     """Move a corrupt artifact aside (``<path>.quarantined``) instead of
     deleting it — the bad bytes stay inspectable until the next sweep.

@@ -26,6 +26,7 @@ from __future__ import annotations
 import glob
 import os
 import re
+import threading
 import time
 from collections import OrderedDict
 
@@ -40,6 +41,7 @@ from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
 from ..transport.wire import RuntimeConfig, StatsRow
 from ..utils.env import env_cast
+from ..utils.locks import OrderedLock
 from ..utils.log import get_logger, set_worker_id
 
 log = get_logger(__name__)
@@ -165,6 +167,21 @@ class ShardEngine:
         self.graph = graph
         self.dc = dc
         self.wid = wid
+        #: base index directory the rows loaded from — where epoch-
+        #: tagged delta-rebuilt indexes (``models.cpd.epoch_index_dir``)
+        #: are discovered for background promotion
+        self.outdir = outdir
+        #: diff epoch of the PROMOTED first-move table (0 = none yet);
+        #: bumped by :meth:`promote_index` when a delta-rebuilt epoch
+        #: index lands. The base table stays resident: batch dispatch
+        #: is epoch-GATED (:meth:`_fm_for`), so only batches naming the
+        #: promoted epoch's fused diff walk the new table. The gate
+        #: state itself lives in ``_fm_promoted`` as ONE ``(epoch,
+        #: table)`` reference (atomic publish under the GIL);
+        #: ``index_epoch`` mirrors the epoch for observers
+        self.index_epoch = 0
+        self._fm_promoted: tuple | None = None
+        self._promote_lock = OrderedLock("worker.ShardEngine.promote")
         #: the SHARD whose rows this engine answers — ``wid`` itself for
         #: a primary engine, another shard when this worker serves a
         #: replica (failover/hedge target). The rows load from the
@@ -222,6 +239,105 @@ class ShardEngine:
         #: back to XLA on the VMEM-fit check (not one per batch)
         self._walk_fallback_logged = False
 
+    # ---------------------------------------------------------- promotion
+    def _fm_for(self, difffile: str):
+        """The table a batch walks: the promoted epoch table ONLY when
+        the batch names the promoted epoch's fused diff file
+        (``fused-e<N>.diff``), the base table otherwise. This gate is
+        what keeps promotion safe under mixed traffic — an in-flight
+        batch pinned to an older epoch (or a free-flow campaign batch)
+        must keep its old-regime routes bit-identical, never pick up
+        new-regime moves priced under its own weights. The published
+        ``(epoch, table)`` pair is read ONCE — promotion swaps it as a
+        single reference, so a concurrent promote can never tear the
+        gate into comparing one epoch against another epoch's table."""
+        promoted = self._fm_promoted        # one read: (epoch, table)
+        if promoted is not None:
+            from ..models.cpd import diff_epoch_of
+
+            if diff_epoch_of(difffile) == promoted[0]:
+                return promoted[1]
+        return self.fm
+
+    def promote_index(self, new_outdir: str, epoch: int) -> bool:
+        """Make a delta-rebuilt epoch-tagged index servable under a
+        running serve: load this shard's rows from ``new_outdir``
+        (digest-verified like any load) and publish them as the
+        PROMOTED table. Dispatch is epoch-gated (:meth:`_fm_for`): a
+        batch naming that epoch's fused diff now gets OPTIMAL routes
+        for the new regime instead of old-regime paths re-priced by
+        query-time diff application, while every other batch — older
+        epochs in flight, free flow — keeps walking the base table
+        unchanged. Returns False (nothing changes) when the load fails:
+        promotion is an optimization, never a serve outage.
+
+        NOTE for result-caching frontends: promotion CHANGES the
+        correct answer for the promoted epoch (re-priced old paths →
+        optimal new paths), so cache entries keyed to that diff epoch
+        that were computed before the promotion must be invalidated —
+        the serving cache's epoch-scoped flush is the tool."""
+        import jax.numpy as jnp
+
+        if self.alg != "table-search":
+            return False
+        try:
+            # heal=False, no graph: the self-heal path would rebuild a
+            # corrupt epoch-index block from THIS engine's free-flow
+            # graph — wrong-regime rows persisted with valid digests
+            # and then served as the epoch's optimal table. A bad
+            # epoch index simply does not promote; the base table is
+            # always a correct fallback.
+            rows = load_shard_rows(new_outdir, self.shard, dc=self.dc,
+                                   heal=False, replica=self.replica)
+        except (OSError, ValueError, FileNotFoundError) as e:
+            log.error("worker %d: cannot promote epoch %d index from "
+                      "%s: %s (keeping epoch %d)", self.wid, epoch,
+                      new_outdir, e, self.index_epoch)
+            return False
+        if rows.shape[0] != self.fm.shape[0]:
+            log.error("worker %d: epoch %d index has %d rows, resident "
+                      "table %d — partition mismatch, not promoting",
+                      self.wid, epoch, rows.shape[0], self.fm.shape[0])
+            return False
+        # single-reference publish under the promote lock, MONOTONE in
+        # epoch: two async promotions finishing out of order must not
+        # let the older one overwrite the newer table (the gate would
+        # then refuse current-epoch traffic until the next swap). The
+        # lock covers only the check+assign; _fm_for reads stay
+        # lock-free on the one published reference.
+        with self._promote_lock:
+            cur = self._fm_promoted
+            if cur is not None and int(epoch) <= cur[0]:
+                log.warning("worker %d: not promoting epoch %d over "
+                            "already-promoted epoch %d", self.wid,
+                            epoch, cur[0])
+                return False
+            self._fm_promoted = (int(epoch), jnp.asarray(rows))
+            self.index_epoch = int(epoch)
+        log.info("worker %d: promoted shard %d to diff-epoch %d index "
+                 "(%s)", self.wid, self.shard, epoch, new_outdir)
+        return True
+
+    def promote_index_async(self, new_outdir: str,
+                            epoch: int) -> threading.Thread:
+        """Background :meth:`promote_index` — the epoch-swap hook's
+        form: the load happens off the serve path and the ``fm`` rebind
+        is a single reference swap. Returns the (daemon) thread so
+        callers that care about completion can join it."""
+        def _run():
+            try:
+                self.promote_index(new_outdir, epoch)
+            except Exception as e:  # noqa: BLE001 — a failed promotion
+                # keeps the old table; the serve path must never die
+                log.error("worker %d: async promotion to epoch %d "
+                          "failed: %s", self.wid, epoch, e)
+
+        t = threading.Thread(
+            target=_run, name=f"dos-build-promote-w{self.wid}",
+            daemon=True)
+        t.start()
+        return t
+
     # ------------------------------------------------------------ weights
     def _weights_for(self, difffile: str, no_cache: bool):
         import jax.numpy as jnp
@@ -277,6 +393,10 @@ class ShardEngine:
         with obs_trace.span("worker.weights", wid=self.wid,
                             difffile=difffile):
             w_pad = self._weights_for(difffile, config.no_cache)
+        # the first-move table is epoch-gated per batch: the promoted
+        # delta index serves ONLY the epoch whose fused diff the batch
+        # names; everything else keeps the base table (see _fm_for)
+        fm_tbl = self._fm_for(difffile)
         M_WEIGHTS.observe(time.perf_counter() - t0)
         nq = len(queries)
         if nq == 0:
@@ -387,7 +507,7 @@ class ShardEngine:
         for _ in range(max(config.itrs, 1)):
             if deadline is None or qpad <= self.astar_chunk:
                 cost, plen, fin = walk_fn(
-                    self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
+                    self.dg, fm_tbl, jnp.asarray(rows), jnp.asarray(s),
                     jnp.asarray(t), w_pad, valid=jnp.asarray(valid),
                     k_moves=config.k_moves)
                 jax.block_until_ready(fin)
@@ -421,7 +541,7 @@ class ShardEngine:
                         break
                     sl = slice(off, off + ch)
                     outs = walk_fn(
-                        self.dg, self.fm, jnp.asarray(rows[sl]),
+                        self.dg, fm_tbl, jnp.asarray(rows[sl]),
                         jnp.asarray(s[sl]), jnp.asarray(t[sl]), w_pad,
                         valid=jnp.asarray(valid[sl]),
                         k_moves=config.k_moves)
@@ -434,7 +554,7 @@ class ShardEngine:
                 break
         if config.extract and config.k_moves > 0:
             nodes, moves = extract_paths(
-                self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
+                self.dg, fm_tbl, jnp.asarray(rows), jnp.asarray(s),
                 jnp.asarray(t), k=config.k_moves)
             nodes = np.asarray(nodes[:nu], np.int64)[unsort]
             moves = np.asarray(moves[:nu], np.int64)[unsort]
@@ -448,7 +568,7 @@ class ShardEngine:
             # k_moves, so the walk's move budget — and therefore every
             # answer — is untouched
             nodes, moves = extract_paths(
-                self.dg, self.fm, jnp.asarray(rows), jnp.asarray(s),
+                self.dg, fm_tbl, jnp.asarray(rows), jnp.asarray(s),
                 jnp.asarray(t), k=int(config.sig_k))
             nodes = np.asarray(nodes[:nu], np.int64)[unsort]
             moves = np.asarray(moves[:nu], np.int64)[unsort]
@@ -484,13 +604,13 @@ class ShardEngine:
 
                 obs_device.capture(
                     f"table-search[pallas]/q{cap_n}/k{config.k_moves}",
-                    _cap_fn, self.dg, self.fm, jnp.asarray(rows[sl]),
+                    _cap_fn, self.dg, fm_tbl, jnp.asarray(rows[sl]),
                     jnp.asarray(s[sl]), jnp.asarray(t[sl]), w_pad,
                     jnp.asarray(valid[sl]))
             else:
                 obs_device.capture(
                     f"table-search/q{cap_n}/k{config.k_moves}",
-                    table_search_batch, self.dg, self.fm,
+                    table_search_batch, self.dg, fm_tbl,
                     jnp.asarray(rows[sl]), jnp.asarray(s[sl]),
                     jnp.asarray(t[sl]), w_pad,
                     valid=jnp.asarray(valid[sl]), k_moves=config.k_moves)

@@ -1,0 +1,518 @@
+"""Pipelined CPD builds and epoch-keyed delta rebuilds.
+
+Non-slow: the pipelined-vs-serial parity smoke (bit-identical blocks on
+the tier-1 grid, staging counters moved), HBM-budget chunk sizing,
+epoch-keyed ledger invalidation, the delta-build correctness suite
+(bit-identical to a from-scratch build on the retimed graph, including
+the empty-delta copy-everything and whole-shard-dirty degrade-to-full
+edges, plus a crash-mid-delta resume drill on the ``crash-build`` fault
+point), engine index promotion, the DiffEpochManager's retime→rebuild
+hook, and the bench-diff direction table for the ``build_*`` keys.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data.graph import Graph
+from distributed_oracle_search_tpu.models.cpd import (
+    BuildLedger, build_chunk_rows, build_worker_shard,
+    delta_affected_targets, delta_build_index, diff_epoch_of,
+    epoch_index_dir, read_manifest, write_index_manifest,
+)
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.utils import atomicio
+
+pytestmark = pytest.mark.build
+
+N_WORKERS = 8
+BLOCK_SIZE = 4
+
+
+@pytest.fixture()
+def toy_dc(toy_graph):
+    return DistributionController("tpu", N_WORKERS, N_WORKERS,
+                                  toy_graph.n, block_size=BLOCK_SIZE)
+
+
+def _build_all(graph, dc, outdir, **kw):
+    for wid in range(dc.maxworker):
+        build_worker_shard(graph, dc, wid, outdir, **kw)
+    write_index_manifest(outdir, dc)
+
+
+def _block_bytes(outdir):
+    return {f: open(os.path.join(outdir, f), "rb").read()
+            for f in sorted(os.listdir(outdir)) if f.startswith("cpd-")}
+
+
+def _counter(snap, name):
+    return snap["counters"].get(name, 0)
+
+
+def _retimed(graph, difffile):
+    return Graph(graph.xs, graph.ys, graph.src, graph.dst,
+                 graph.weights_with_diff(difffile))
+
+
+def _hot_diff(tmp_path, graph, eids, mult=3):
+    """A fused-diff file multiplying the named edges' weights."""
+    from distributed_oracle_search_tpu.data.formats import write_diff
+
+    path = str(tmp_path / "fused-e000005.diff")
+    eids = np.asarray(eids)
+    write_diff(path, graph.src[eids], graph.dst[eids],
+               graph.w[eids].astype(np.int64) * mult)
+    return path
+
+
+# ------------------------------------------------------ pipeline parity
+
+def test_pipelined_build_bit_identical_to_serial(tmp_path, toy_graph,
+                                                 toy_dc, monkeypatch):
+    """The tier-1 parity smoke: the pipelined loop (background stager,
+    pre-opened writers, device-staged targets) must produce byte-
+    identical block files to the serial reference loop — staging moves
+    WHEN inputs are prepared, never what the kernels compute."""
+    snap0 = obs_metrics.REGISTRY.snapshot()
+    d_pipe = str(tmp_path / "pipe")
+    monkeypatch.setenv("DOS_BUILD_PIPELINE", "1")
+    _build_all(toy_graph, toy_dc, d_pipe)
+    snap1 = obs_metrics.REGISTRY.snapshot()
+    d_serial = str(tmp_path / "serial")
+    monkeypatch.setenv("DOS_BUILD_PIPELINE", "0")
+    _build_all(toy_graph, toy_dc, d_serial)
+    assert _block_bytes(d_pipe) == _block_bytes(d_serial)
+    # the stager actually ran and counted its rows
+    assert (_counter(snap1, "build_rows_staged_total")
+            - _counter(snap0, "build_rows_staged_total")) == toy_graph.n
+
+
+def test_pipeline_small_chunk_parity(tmp_path, toy_graph, toy_dc):
+    """Multi-chunk blocks (chunk < block size) keep parity through the
+    pipeline — the chunked staging path, not just one pad per block."""
+    d1 = str(tmp_path / "c2")
+    d2 = str(tmp_path / "whole")
+    _build_all(toy_graph, toy_dc, d1, chunk=2)
+    _build_all(toy_graph, toy_dc, d2)
+    assert _block_bytes(d1) == _block_bytes(d2)
+
+
+def test_pipeline_resume_recomputes_only_missing(tmp_path, toy_graph,
+                                                 toy_dc):
+    d = str(tmp_path / "idx")
+    build_worker_shard(toy_graph, toy_dc, 0, d)
+    victim = "cpd-w00000-b00001.npy"
+    os.unlink(os.path.join(d, victim))
+    written = build_worker_shard(toy_graph, toy_dc, 0, d)
+    assert written == [victim]
+
+
+def test_build_chunk_rows_budget(toy_graph, monkeypatch):
+    n_owned = 512
+    # explicit chunk always wins
+    assert build_chunk_rows(toy_graph, 64, n_owned) == 64
+    # unset budget keeps the legacy whole-shard batch
+    monkeypatch.delenv("DOS_BUILD_HBM_MB", raising=False)
+    assert build_chunk_rows(toy_graph, 0, n_owned) == n_owned
+    # a budget sizes the chunk: rows = budget // per-row bytes, pow2
+    k = max(toy_graph.max_out_degree, 1)
+    per_row = toy_graph.n * (k + 2) * 4
+    monkeypatch.setenv("DOS_BUILD_HBM_MB", str(100 * per_row / 1e6))
+    got = build_chunk_rows(toy_graph, 0, n_owned, kind="ell")
+    assert got == 64          # pow2 floor of 100
+    # budget larger than the shard clamps to the shard
+    monkeypatch.setenv("DOS_BUILD_HBM_MB", "1e9")
+    assert build_chunk_rows(toy_graph, 0, 48) <= 48
+    # malformed degrades to the default (no crash)
+    monkeypatch.setenv("DOS_BUILD_HBM_MB", "not-a-number")
+    assert build_chunk_rows(toy_graph, 0, n_owned) == n_owned
+
+
+def test_atomic_npy_writer_and_copy(tmp_path):
+    p = str(tmp_path / "b.npy")
+    w = atomicio.AtomicNpyWriter(p)
+    arr = np.arange(12, dtype=np.int8).reshape(3, 4)
+    digest = w.commit(arr)
+    assert (np.load(p) == arr).all()
+    assert digest == atomicio.digest_file(p)
+    # abort leaves nothing behind
+    w2 = atomicio.AtomicNpyWriter(str(tmp_path / "c.npy"))
+    w2.abort()
+    assert os.listdir(tmp_path) == ["b.npy"]
+    # atomic copy returns the copied digest
+    q = str(tmp_path / "copy.npy")
+    assert atomicio.atomic_copy_file(p, q) == digest
+    assert open(q, "rb").read() == open(p, "rb").read()
+
+
+# ------------------------------------------------- epoch-keyed ledger
+
+def test_epoch_keyed_ledger_invalidation(tmp_path, toy_graph, toy_dc):
+    """A parseable block journaled under ANOTHER epoch (or none) must
+    not satisfy an epoch-keyed resume — stale weight regimes are
+    invalidated, not adopted."""
+    d = str(tmp_path / "idx")
+    build_worker_shard(toy_graph, toy_dc, 0, d, epoch=1)
+    ledger = BuildLedger(d, 0)
+    assert all(e.get("epoch") == 1 for e in ledger.entries().values())
+    # same epoch: everything resumes
+    assert build_worker_shard(toy_graph, toy_dc, 0, d, epoch=1) == []
+    # different epoch: every block is rebuilt
+    written = build_worker_shard(toy_graph, toy_dc, 0, d, epoch=2)
+    assert len(written) == 2
+    # un-keyed build over epoch-keyed ledger keeps legacy semantics
+    assert build_worker_shard(toy_graph, toy_dc, 0, d) == []
+
+
+# ------------------------------------------------------- delta builds
+
+def test_delta_build_bit_identical_and_skips(tmp_path, toy_graph,
+                                             toy_dc):
+    """The core delta contract: old index + fused diff must reproduce
+    a from-scratch build on the retimed graph bit-for-bit, while
+    recomputing only the dirty rows and byte-copying clean blocks."""
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    # one increased + one mildly decreased edge (both tense directions)
+    # with SMALL dirty cones — on a 48-node graph most edges sit on
+    # co-optimal paths to over half the targets (whole-graph dirty is
+    # the degrade test's regime, not this one's); edges 26/41 measured
+    # 1-row cones under these perturbations
+    from distributed_oracle_search_tpu.data.formats import write_diff
+    fused = str(tmp_path / "fused-e000005.diff")
+    e1, e2 = 26, 41
+    write_diff(fused,
+               toy_graph.src[[e1, e2]], toy_graph.dst[[e1, e2]],
+               np.asarray([int(toy_graph.w[e1]) * 7,
+                           max(int(toy_graph.w[e2]) - 1, 1)]))
+    snap0 = obs_metrics.REGISTRY.snapshot()
+    rep = delta_build_index(toy_graph, toy_dc, old, fused)
+    snap1 = obs_metrics.REGISTRY.snapshot()
+    assert rep["epoch"] == 5                  # parsed from the name
+    assert rep["outdir"] == epoch_index_dir(old, 5)
+    scratch = str(tmp_path / "scratch")
+    _build_all(_retimed(toy_graph, fused), toy_dc, scratch)
+    assert _block_bytes(rep["outdir"]) == _block_bytes(scratch)
+    # real sparsity on the toy graph: some rows skipped, some redone
+    assert 0 < rep["affected_rows"] < toy_graph.n
+    assert rep["rows_recomputed"] < toy_graph.n
+    assert rep["blocks_skipped"] > 0
+    assert not rep["degraded_full"]
+    assert (_counter(snap1, "build_delta_rows_recomputed_total")
+            - _counter(snap0, "build_delta_rows_recomputed_total")
+            ) == rep["rows_recomputed"]
+    assert (_counter(snap1, "build_delta_skipped_blocks_total")
+            - _counter(snap0, "build_delta_skipped_blocks_total")
+            ) == rep["blocks_skipped"]
+    # the new manifest is a valid epoch-tagged index
+    man = read_manifest(rep["outdir"])
+    assert man["diff_epoch"] == 5
+    assert man["diff_file"] == os.path.abspath(fused)
+
+
+def test_delta_empty_diff_copies_everything(tmp_path, toy_graph,
+                                            toy_dc):
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    # a "retime" to the weights already in force: zero changed edges
+    from distributed_oracle_search_tpu.data.formats import write_diff
+    fused = str(tmp_path / "fused-e000002.diff")
+    write_diff(fused, toy_graph.src[:3], toy_graph.dst[:3],
+               toy_graph.w[:3])
+    rep = delta_build_index(toy_graph, toy_dc, old, fused)
+    assert rep["changed_edges"] == 0
+    assert rep["rows_recomputed"] == 0
+    assert rep["affected_rows"] == 0
+    n_blocks = sum(-(-toy_dc.n_owned(w) // BLOCK_SIZE)
+                   for w in range(N_WORKERS))
+    assert rep["blocks_skipped"] == n_blocks
+    assert _block_bytes(rep["outdir"]) == _block_bytes(old)
+
+
+def test_delta_whole_shard_dirty_degrades_to_full(tmp_path, toy_graph,
+                                                  toy_dc, monkeypatch):
+    """Past the seed bound the dirty pass is inconclusive and the delta
+    degrades to a full (pipelined) rebuild — still bit-identical."""
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    fused = _hot_diff(tmp_path, toy_graph, [1, 5, 9], mult=4)
+    monkeypatch.setenv("DOS_BUILD_DELTA_MAX_SEEDS", "2")
+    rep = delta_build_index(toy_graph, toy_dc, old, fused)
+    assert rep["degraded_full"]
+    assert rep["blocks_skipped"] == 0
+    scratch = str(tmp_path / "scratch")
+    _build_all(_retimed(toy_graph, fused), toy_dc, scratch)
+    assert _block_bytes(rep["outdir"]) == _block_bytes(scratch)
+
+
+def test_delta_affected_targets_bound_and_empty(toy_graph):
+    assert len(delta_affected_targets(
+        toy_graph, np.zeros(0, np.int64), toy_graph.w, toy_graph.w)) == 0
+    w2 = toy_graph.w.copy()
+    w2[:8] = w2[:8] * 2
+    assert delta_affected_targets(
+        toy_graph, np.arange(8), toy_graph.w, w2, max_seeds=3) is None
+
+
+def test_delta_crash_mid_build_resumes(tmp_path, toy_graph, toy_dc,
+                                       monkeypatch):
+    """crash-build fires between delta block flushes; the rerun resumes
+    off the epoch-keyed ledger and the finished index is bit-identical
+    to an uninterrupted delta (and therefore to a scratch build)."""
+    from distributed_oracle_search_tpu.testing import faults
+
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    fused = _hot_diff(tmp_path, toy_graph, [2], mult=9)
+    monkeypatch.setenv("DOS_FAULTS",
+                       "crash-build;mode=raise;after=3;times=1")
+    faults.reset()
+    with pytest.raises(RuntimeError, match="crash-build"):
+        delta_build_index(toy_graph, toy_dc, old, fused)
+    monkeypatch.delenv("DOS_FAULTS")
+    faults.reset()
+    snap0 = obs_metrics.REGISTRY.snapshot()
+    rep = delta_build_index(toy_graph, toy_dc, old, fused)
+    snap1 = obs_metrics.REGISTRY.snapshot()
+    assert rep["blocks_resumed"] > 0
+    assert (_counter(snap1, "build_blocks_resumed_total")
+            > _counter(snap0, "build_blocks_resumed_total"))
+    scratch = str(tmp_path / "scratch")
+    _build_all(_retimed(toy_graph, fused), toy_dc, scratch)
+    assert _block_bytes(rep["outdir"]) == _block_bytes(scratch)
+
+
+def test_diff_epoch_of():
+    assert diff_epoch_of("spool/fused-e000042.diff") == 42
+    assert diff_epoch_of("road.xy.diff") is None
+    assert diff_epoch_of("") is None
+
+
+# --------------------------------------------------- index promotion
+
+def test_engine_promotes_epoch_index(tmp_path, toy_graph, toy_dc):
+    """A promoted delta index serves the fused epoch with OPTIMAL
+    routes: the promoted engine's answers equal a fresh engine loaded
+    from a scratch build on the retimed graph."""
+    from distributed_oracle_search_tpu.transport.wire import (
+        RuntimeConfig,
+    )
+    from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    fused = _hot_diff(tmp_path, toy_graph, [4], mult=11)
+    rep = delta_build_index(toy_graph, toy_dc, old, fused)
+    wid = 0
+    rng = np.random.default_rng(3)
+    owned = toy_dc.owned(wid)
+    queries = np.stack([rng.integers(0, toy_graph.n, 32),
+                        rng.choice(owned, 32)], axis=1)
+    eng = ShardEngine(toy_graph, toy_dc, wid, old)
+    assert eng.index_epoch == 0
+    t = eng.promote_index_async(rep["outdir"], rep["epoch"])
+    t.join(timeout=30)
+    assert eng.index_epoch == rep["epoch"]
+    scratch = str(tmp_path / "scratch")
+    _build_all(_retimed(toy_graph, fused), toy_dc, scratch)
+    ref = ShardEngine(toy_graph, toy_dc, wid, scratch)
+    got = eng.answer(queries, RuntimeConfig(), difffile=fused)
+    want = ref.answer(queries, RuntimeConfig(), difffile=fused)
+    for a, b in zip(got[:3], want[:3]):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    # the epoch GATE: a batch naming any other diff (here free flow)
+    # still walks the BASE table after promotion — a promoted epoch
+    # must never leak new-regime moves into older-epoch or free-flow
+    # traffic priced under its own weights
+    base = ShardEngine(toy_graph, toy_dc, wid, old)
+    got_ff = eng.answer(queries, RuntimeConfig())
+    want_ff = base.answer(queries, RuntimeConfig())
+    for a, b in zip(got_ff[:3], want_ff[:3]):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_engine_promotion_failure_keeps_old_table(tmp_path, toy_graph,
+                                                  toy_dc):
+    from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    eng = ShardEngine(toy_graph, toy_dc, 0, old)
+    fm_before = eng.fm
+    assert not eng.promote_index(str(tmp_path / "nope"), 3)
+    assert eng.index_epoch == 0
+    assert eng.fm is fm_before
+
+
+def test_engine_promotion_is_monotone(tmp_path, toy_graph, toy_dc):
+    """Out-of-order async promotions must not regress the gate: an
+    older epoch finishing after a newer one is refused, so current-
+    epoch traffic keeps the newest promoted table."""
+    from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    fused5 = _hot_diff(tmp_path, toy_graph, [26], mult=7)
+    rep5 = delta_build_index(toy_graph, toy_dc, old, fused5)
+    eng = ShardEngine(toy_graph, toy_dc, 0, old)
+    assert eng.promote_index(rep5["outdir"], rep5["epoch"])
+    # the slower, older promotion loses
+    assert not eng.promote_index(rep5["outdir"], rep5["epoch"] - 1)
+    assert not eng.promote_index(rep5["outdir"], rep5["epoch"])
+    assert eng.index_epoch == rep5["epoch"]
+
+
+def test_engine_promotion_never_heals_with_freeflow_graph(
+        tmp_path, toy_graph, toy_dc):
+    """A corrupt epoch-index block must FAIL the promotion (base table
+    stays), never self-heal — the engine's heal path rebuilds from its
+    free-flow graph, which would persist wrong-regime rows into the
+    epoch index under valid digests."""
+    from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    fused = _hot_diff(tmp_path, toy_graph, [26], mult=7)
+    rep = delta_build_index(toy_graph, toy_dc, old, fused)
+    victim = os.path.join(rep["outdir"], "cpd-w00000-b00000.npy")
+    raw = bytearray(open(victim, "rb").read())
+    raw[-3] ^= 0xFF
+    open(victim, "wb").write(bytes(raw))
+    eng = ShardEngine(toy_graph, toy_dc, 0, old)
+    assert not eng.promote_index(rep["outdir"], rep["epoch"])
+    assert eng.index_epoch == 0
+    # not quarantined, not rebuilt: the bad bytes are untouched
+    assert open(victim, "rb").read() == bytes(raw)
+    assert not os.path.exists(victim + ".quarantined")
+
+
+def test_delta_pruned_old_diff_degrades_to_full(tmp_path, toy_graph,
+                                                toy_dc):
+    """Delta-on-delta chaining when the old index's recorded fused
+    diff was pruned from the spool: the changed set is unknowable, so
+    the delta degrades to a full rebuild — still a correct, bit-
+    identical epoch index, never a failed chain link."""
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    fused2 = _hot_diff(tmp_path, toy_graph, [26], mult=7)
+    os.rename(fused2, str(tmp_path / "fused-e000002.diff"))
+    fused2 = str(tmp_path / "fused-e000002.diff")
+    rep2 = delta_build_index(toy_graph, toy_dc, old, fused2)
+    os.unlink(fused2)                      # the spool pruned it
+    fused3 = _hot_diff(tmp_path, toy_graph, [41], mult=9)
+    os.rename(fused3, str(tmp_path / "fused-e000003.diff"))
+    fused3 = str(tmp_path / "fused-e000003.diff")
+    rep3 = delta_build_index(toy_graph, toy_dc, rep2["outdir"], fused3)
+    assert rep3["degraded_full"]
+    scratch = str(tmp_path / "scratch")
+    _build_all(_retimed(toy_graph, fused3), toy_dc, scratch)
+    assert _block_bytes(rep3["outdir"]) == _block_bytes(scratch)
+
+
+# ------------------------------------------------- retime→rebuild hook
+
+def test_epoch_manager_on_swap_hook(tmp_path):
+    from distributed_oracle_search_tpu.traffic import (
+        DiffEpochManager, write_segment,
+    )
+
+    d = str(tmp_path / "stream")
+    os.makedirs(d)
+    calls = []
+    m = DiffEpochManager(
+        d, on_swap=lambda e, f, aff: calls.append((e, f, set(aff))))
+    write_segment(d, 1, [0, 1], [1, 2], [50, 60])
+    assert m.refresh()
+    assert calls == [(1, m.fused_path(1), {(0, 1), (1, 2)})]
+    # a raising hook is logged, never unwinds the swap
+    m.on_swap = lambda *a: (_ for _ in ()).throw(ValueError("boom"))
+    write_segment(d, 2, [0], [1], [70])
+    assert m.refresh()
+    assert m.epoch == 2
+
+
+# ----------------------------------------------------- bench-diff gate
+
+def _bench_record(tmp_path, name, headline):
+    p = tmp_path / name
+    p.write_text(json.dumps({
+        "metric": "scenario_queries_per_sec", "value": 100000.0,
+        "headline": headline}))
+    return str(p)
+
+
+def test_bench_diff_knows_build_key_directions(tmp_path):
+    """build_* headline keys gate with the right directions: build
+    rates and the delta-vs-full ratio are higher-is-better, pipeline
+    stall lower-is-better — and staging OVERLAP higher-is-better
+    despite its _seconds suffix (the heuristic-defeating case the
+    explicit table exists for)."""
+    from distributed_oracle_search_tpu.obs import fleet
+
+    old = _bench_record(tmp_path, "BENCH_r01.json", {
+        "scale_build_rows_per_sec": 300.0,
+        "road_tpu_build_rows_per_sec": 42.0,
+        "build_delta_vs_full_ratio": 10.0,
+        "build_pipeline_stall_seconds": 0.5,
+        "build_stage_overlap_seconds": 2.0,
+    })
+    bad = _bench_record(tmp_path, "BENCH_r02.json", {
+        "scale_build_rows_per_sec": 100.0,       # drop: regression
+        "road_tpu_build_rows_per_sec": 12.0,     # drop: regression
+        "build_delta_vs_full_ratio": 7.5,        # -25% > 20% tol
+        "build_pipeline_stall_seconds": 2.0,     # rise: regression
+        "build_stage_overlap_seconds": 0.2,      # DROP: regression
+    })
+    out = fleet.compare_bench(old, bad)
+    by_key = {e["key"]: e for e in out["regressions"]}
+    assert by_key["scale_build_rows_per_sec"]["direction"] == "higher"
+    assert by_key["road_tpu_build_rows_per_sec"]["direction"] == "higher"
+    assert by_key["build_delta_vs_full_ratio"]["direction"] == "higher"
+    assert by_key["build_delta_vs_full_ratio"]["tolerance"] == \
+        pytest.approx(0.2)
+    assert by_key["build_pipeline_stall_seconds"]["direction"] == "lower"
+    assert by_key["build_stage_overlap_seconds"]["direction"] == "higher"
+
+    ok = _bench_record(tmp_path, "BENCH_r03.json", {
+        "scale_build_rows_per_sec": 320.0,
+        "road_tpu_build_rows_per_sec": 210.0,
+        "build_delta_vs_full_ratio": 12.0,
+        "build_pipeline_stall_seconds": 0.1,
+        "build_stage_overlap_seconds": 2.4,
+    })
+    assert fleet.compare_bench(old, ok)["regressions"] == []
+
+
+# ---------------------------------------------------------- CLI drive
+
+def test_make_cpds_delta_from_cli(tmp_path, toy_graph, toy_dc,
+                                  capsys):
+    """``dos-make-cpds --delta-from OLD --diff FUSED`` end to end."""
+    from distributed_oracle_search_tpu.cli.make_cpds import main
+    from distributed_oracle_search_tpu.data import write_xy
+
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    fused = _hot_diff(tmp_path, toy_graph, [6], mult=5)
+    xy = str(tmp_path / "g.xy")
+    write_xy(xy, toy_graph.xs, toy_graph.ys, toy_graph.src,
+             toy_graph.dst, toy_graph.w)
+    conf = str(tmp_path / "conf.json")
+    with open(conf, "w") as f:
+        json.dump({"workers": [f"tpu:{i}" for i in range(N_WORKERS)],
+                   "partmethod": "tpu", "partkey": N_WORKERS,
+                   "outdir": old, "xy_file": xy}, f)
+    rc = main(["-c", conf, "--delta-from", old, "--diff", fused])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["exit_code"] == 0
+    assert out["epoch"] == 5
+    scratch = str(tmp_path / "scratch")
+    _build_all(_retimed(toy_graph, fused), toy_dc, scratch)
+    assert _block_bytes(out["outdir"]) == _block_bytes(scratch)
