@@ -273,7 +273,10 @@ os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
     " --xla_force_host_platform_device_count=8"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass   # older jax: the XLA_FLAGS form above already applies
 try:    # persistent compile cache: repeat runs skip the 8 mesh compiles
     jax.config.update("jax_compilation_cache_dir",
                       os.path.expanduser("~/.cache/jax_bench"))
@@ -318,6 +321,114 @@ print(json.dumps({{"mesh": mesh_s, "shard": shard_s,
         timeout=900)
     if res.returncode != 0:
         log(f"weak-scaling subprocess failed: {res.stderr[-500:]}")
+        return {}
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def _mesh_scaling(side: int, chunk: int):
+    """Multi-device mesh execution over 1/2/4/8 virtual CPU devices
+    (subprocess, like :func:`_weak_scaling`): per device count, the
+    lane-mesh build rate, the lane-split engine walk rate, and the
+    on-mesh collective ``mat`` rate — with every answer asserted
+    bit-identical to the single-device run inside the subprocess, so
+    a parity break fails the section rather than recording a lie.
+
+    The 8 virtual devices time-slice ONE core, so these rates measure
+    dispatch/partition overhead, not speedup — flat-ish series = the
+    mesh machinery is roughly free, which is the most a one-core host
+    can prove (the speedup claim belongs to the hardware round, same
+    caveat as the weak-scaling section).
+    """
+    code = f"""
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+try:    # persistent compile cache: repeat runs skip the mesh compiles
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
+import numpy as np, tempfile, shutil
+from distributed_oracle_search_tpu.data import (
+    synth_city_graph, synth_scenario)
+from distributed_oracle_search_tpu.models.cpd import (
+    CPDOracle, build_worker_shard)
+from distributed_oracle_search_tpu.parallel import DistributionController
+from distributed_oracle_search_tpu.parallel.mesh import make_mesh
+from distributed_oracle_search_tpu.transport.wire import RuntimeConfig
+from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+g = synth_city_graph({side}, {side}, seed=0)
+dc = DistributionController("tpu", None, 1, g.n)
+queries = synth_scenario(g.n, 8192, seed=13)
+rc = RuntimeConfig()
+idx = tempfile.mkdtemp()
+try:
+    build_worker_shard(g, dc, 0, idx, chunk={chunk})
+    mat_s = int(queries[0][0])
+    mat_t = np.arange(g.n)[:512]
+    build_s, walk_s, mat_s_sec = {{}}, {{}}, {{}}
+    walk_base = mat_base = None
+    for L in (1, 2, 4, 8):
+        os.environ["DOS_MESH_DEVICES"] = str(L)
+        # lane-mesh build (fresh ctx per L: the lane mesh is part of it)
+        ctx = {{}}
+        d = tempfile.mkdtemp()
+        try:
+            build_worker_shard(g, dc, 0, d, chunk={chunk}, ctx=ctx)
+            shutil.rmtree(d); os.makedirs(d)
+            t0 = time.perf_counter()
+            build_worker_shard(g, dc, 0, d, chunk={chunk},
+                               resume=False, ctx=ctx)
+            build_s[str(L)] = round(g.n / (time.perf_counter() - t0), 1)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        # lane-split walk through the engine (est-sort + buckets + unsort)
+        eng = ShardEngine(g, dc, 0, idx)
+        assert eng.n_lanes == L, (eng.n_lanes, L)
+        eng.answer(queries, rc)
+        t0 = time.perf_counter()
+        c, p, f, _st = eng.answer(queries, rc)
+        walk_s[str(L)] = round(len(queries) / (time.perf_counter() - t0), 1)
+        if walk_base is None:
+            walk_base = (c, p, f)
+        else:
+            for a, b in zip(walk_base, (c, p, f)):
+                np.testing.assert_array_equal(a, b)
+        # on-mesh collective mat: one worker shard per device
+        dcl = DistributionController("tpu", None, L, g.n)
+        ol = CPDOracle(g, dcl, mesh=make_mesh(n_workers=L)).build(
+            chunk={chunk})
+        ol.query_mat(mat_s, mat_t)
+        t0 = time.perf_counter()
+        for _ in range(4):
+            mc, mf = ol.query_mat(mat_s, mat_t)
+        mat_s_sec[str(L)] = round(
+            4 * len(mat_t) / (time.perf_counter() - t0), 1)
+        if mat_base is None:
+            mat_base = (mc, mf)
+        else:
+            np.testing.assert_array_equal(mat_base[0], mc)
+            np.testing.assert_array_equal(mat_base[1], mf)
+finally:
+    shutil.rmtree(idx, ignore_errors=True)
+print(json.dumps({{"build": build_s, "walk": walk_s,
+                   "mat": mat_s_sec}}))
+"""
+    res = subprocess.run([sys.executable, "-c", code], cwd=os.path.dirname(
+        os.path.abspath(__file__)), capture_output=True, text=True,
+        timeout=1200)
+    if res.returncode != 0:
+        log(f"mesh-scaling subprocess failed: {res.stderr[-500:]}")
         return {}
     return json.loads(res.stdout.strip().splitlines()[-1])
 
@@ -1590,16 +1701,25 @@ def main() -> None:
     # multi-chip hardware.
     if os.environ.get("BENCH_WEAK", "1") != "0":
         from distributed_oracle_search_tpu.models.cpd import (
-            build_worker_shard,
+            _make_chunk_compute, build_worker_shard,
         )
 
         shard_dev = {}
         shard_rps = {}
+        shard_disp = {}
+        shard_comp = {}
+        shard_over = {}
+        # ONE shared compute context across warm-up, every W, and every
+        # rep: DeviceGraph upload + build-kernel resolution are
+        # per-process setup a resident worker pays once, and re-paying
+        # them per timed rep was per-shard overhead polluting the
+        # strong-scaling series (the same hoist as PR 11's ledger one)
+        bctx = {}
         warm = tempfile.mkdtemp(prefix="dos-shard-warm-")
         try:  # one warm-up build compiles the chunked program
             build_worker_shard(
                 g, DistributionController("tpu", None, 8, g.n), 0, warm,
-                chunk=chunk)
+                chunk=chunk, ctx=bctx)
         finally:
             shutil.rmtree(warm, ignore_errors=True)
         for wsh in (1, 2, 4, 8):
@@ -1615,7 +1735,7 @@ def main() -> None:
                 # the ledger read would be pure timed-region overhead
                 _, t_sh_s = robust_time(
                     lambda: build_worker_shard(g, dcw, 0, d, chunk=chunk,
-                                               resume=False),
+                                               resume=False, ctx=bctx),
                     reset=_reset_sh,
                     # ~2x the best r05 readings per W, default knobs only
                     band_s=({1: 4.0, 2: 2.2, 4: 1.4, 8: 0.9}[wsh]
@@ -1624,6 +1744,30 @@ def main() -> None:
                     label=f"shard-w{wsh}")
                 shard_dev[str(wsh)] = round(t_sh_s, 3)
                 shard_rps[str(wsh)] = round(dcw.n_owned(0) / t_sh_s, 1)
+                # dispatch-vs-compute decomposition of the SAME rows:
+                # issue every chunk kernel call without blocking
+                # (dispatch = host-side call cost), then block (compute
+                # = device wall-clock). total-build minus compute is
+                # the per-shard overhead — writer fsyncs, ledger lines,
+                # fetch/encode — the series that explains WHY rows/s
+                # regresses as the per-shard row count shrinks.
+                kind_b, st_b = bctx["kernel"]
+                compute = _make_chunk_compute(bctx["dg"], kind_b, st_b, 0)
+                owned_w = dcw.owned(0)
+                pads_w = []
+                for off in range(0, len(owned_w), chunk):
+                    part = owned_w[off:off + chunk]
+                    pad = np.full(chunk, -1, np.int32)
+                    pad[:len(part)] = part
+                    pads_w.append(pad)
+                t0 = time.perf_counter()
+                outs = [compute(p) for p in pads_w]
+                t_disp = time.perf_counter() - t0
+                jax.block_until_ready([dv for dv, _cd in outs])
+                t_comp = time.perf_counter() - t0
+                shard_disp[str(wsh)] = round(t_disp, 4)
+                shard_comp[str(wsh)] = round(t_comp, 4)
+                shard_over[str(wsh)] = round(max(t_sh_s - t_comp, 0.0), 4)
             finally:
                 shutil.rmtree(d, ignore_errors=True)
         base = shard_dev["1"]
@@ -1631,8 +1775,24 @@ def main() -> None:
             "W-way partition): " + ", ".join(
                 f"W={w}: {s}s (x{base / s:.2f})"
                 for w, s in shard_dev.items()))
+        log("shard strong scaling breakdown (dispatch / compute / "
+            "overhead s): " + ", ".join(
+                f"W={w}: {shard_disp[w]}/{shard_comp[w]}/{shard_over[w]}"
+                for w in shard_dev))
         weak_stats["shard_strong_scaling_device_seconds"] = shard_dev
         weak_stats["shard_strong_scaling_rows_per_sec"] = shard_rps
+        weak_stats["shard_strong_scaling_dispatch_seconds"] = shard_disp
+        weak_stats["shard_strong_scaling_compute_seconds"] = shard_comp
+        weak_stats["shard_strong_scaling_overhead_seconds"] = shard_over
+        # scalar twins for the bench-diff gate (it compares numbers,
+        # not dicts): the W=1/W=8 endpoints pin the strong-scaling
+        # trend so the measured regression cannot silently widen
+        weak_stats["shard_strong_scaling_rows_per_sec_w1"] = \
+            shard_rps["1"]
+        weak_stats["shard_strong_scaling_rows_per_sec_w8"] = \
+            shard_rps["8"]
+        weak_stats["shard_strong_scaling_overhead_w8_seconds"] = \
+            shard_over["8"]
 
         # sharded streamed serving: two controller processes split one
         # streamed campaign's uploads (each streams only its workers'
@@ -1668,6 +1828,69 @@ def main() -> None:
                     max(split) / tot, 3)
         finally:
             shutil.rmtree(sdir, ignore_errors=True)
+
+    # ---- worker mesh: multi-device sharded execution per device count
+    # (lane-mesh build, lane-split walk, on-mesh collective mat) on the
+    # 8-virtual-CPU-device shim — parity-asserted inside the
+    # subprocess. BENCH_MESH=0 skips.
+    mesh_stats = {}
+    if os.environ.get("BENCH_MESH", "1") != "0":
+        log("worker mesh (1/2/4/8 virtual CPU devices, subprocess)...")
+        meshr = _mesh_scaling(side=64, chunk=512)
+        if meshr:
+            mesh_stats = {
+                "mesh_build_rows_per_sec": meshr["build"],
+                "mesh_walk_queries_per_sec": meshr["walk"],
+                "mesh_mat_rows_per_sec": meshr["mat"],
+                # scalar twins for the bench-diff gate (dict keys are
+                # not compared); d8 = the full-mesh end of each series
+                "mesh_build_rows_per_sec_d8": meshr["build"]["8"],
+                "mesh_walk_queries_per_sec_d8": meshr["walk"]["8"],
+                "mesh_mat_rows_per_sec_d8": meshr["mat"]["8"],
+            }
+            for name, series in (("build rows/s", meshr["build"]),
+                                 ("walk q/s", meshr["walk"]),
+                                 ("mat rows/s", meshr["mat"])):
+                log(f"mesh {name} (one time-sliced core — overhead "
+                    "proxy, not speedup): " + ", ".join(
+                        f"L={k}: {v:,.0f}" for k, v in series.items()))
+
+    # ---- multichip smoke: the full sharded pipeline step on an 8-
+    # device (data x worker) mesh — previously a detached
+    # MULTICHIP_r*.json dryrun artifact, now a recorded bench section
+    # so multichip health rides the same bench-diff gate
+    # (multichip_smoke_ok is tolerance-0: any 1 -> 0 drop gates).
+    # BENCH_MULTICHIP=0 skips.
+    multichip_stats = {}
+    if os.environ.get("BENCH_MULTICHIP", "1") != "0":
+        log("multichip smoke (dryrun_multichip on 8 virtual CPU "
+            "devices)...")
+        here = os.path.dirname(os.path.abspath(__file__))
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.join(here, "__graft_entry__.py"),
+                 "8"], cwd=here, env=env, capture_output=True, text=True,
+                timeout=900)
+            ok = (res.returncode == 0
+                  and "dryrun_multichip OK" in res.stdout)
+            tail = (res.stdout or res.stderr).strip().splitlines()
+            multichip_stats = {
+                "multichip_smoke_ok": 1 if ok else 0,
+                "multichip_devices": 8,
+                "multichip_tail": tail[-1][:200] if tail else "",
+            }
+        except (subprocess.TimeoutExpired, OSError) as e:
+            log(f"multichip smoke failed to run: {e}")
+            multichip_stats = {"multichip_smoke_ok": 0,
+                               "multichip_devices": 8,
+                               "multichip_tail": str(e)[:200]}
+        log(f"multichip smoke: "
+            f"{'OK' if multichip_stats['multichip_smoke_ok'] else 'FAIL'}"
+            f" ({multichip_stats['multichip_tail']})")
 
     # ---- online serving: open-loop Poisson load against the serving
     # frontend (serving/) backed by the resident oracle — throughput,
@@ -2263,6 +2486,8 @@ def main() -> None:
         **road_stats,
         **delta_stats,
         **weak_stats,
+        **mesh_stats,
+        **multichip_stats,
         **serve_stats,
         **repl_stats,
         **reshard_stats,
@@ -2310,6 +2535,11 @@ def main() -> None:
         "road_tpu_resident_speedup", "road_multidiff_fused_speedup",
         "build_delta_vs_full_ratio", "build_delta_rows_per_sec",
         "shard_strong_scaling_rows_per_sec",
+        "shard_strong_scaling_rows_per_sec_w1",
+        "shard_strong_scaling_rows_per_sec_w8",
+        "shard_strong_scaling_overhead_w8_seconds",
+        "mesh_build_rows_per_sec_d8", "mesh_walk_queries_per_sec_d8",
+        "mesh_mat_rows_per_sec_d8", "multichip_smoke_ok",
         "serve_queries_per_sec", "serve_p99_ms",
         "serve_cache_hit_rate", "serve_mean_batch_fill",
         "traffic_live_swap_queries_per_sec", "traffic_swap_stall_p99_ms",

@@ -780,12 +780,20 @@ def run(conf: ClusterConfig, args):
                                             and partmethod == "tpu")
         # replication is a host-wire concept (replica block sets on
         # distinct workers + failover over the FIFO wire); the
-        # in-process mesh has no per-worker failure domain to replicate
-        # across, so TPU mode pins R=1
+        # in-process CAMPAIGN mesh routes every query to its primary
+        # owner and its build-if-missing path saves a primary-only
+        # index, so TPU campaigns pin R=1. The TPU-backed path that
+        # DOES serve replicas is the serving layer (EngineDispatcher /
+        # worker server): there replica rank r pins to worker-mesh
+        # lane r % L (DOS_MESH_DEVICES, worker.engine replica-lane
+        # placement), giving breaker/hedge/failover a real second
+        # device on one host.
         replication = 1 if use_tpu else conf.effective_replication()
         if use_tpu and conf.effective_replication() > 1:
-            log.info("replication=%d ignored on the TPU backend "
-                     "(in-process mesh has one failure domain)",
+            log.info("replication=%d ignored on the TPU campaign "
+                     "backend (queries route to primary owners only; "
+                     "replica LANES apply to the serving layer — see "
+                     "README 'Worker mesh')",
                      conf.effective_replication())
         dc = DistributionController(partmethod, partkey, conf.maxworker,
                                     nodenum, replication=replication)

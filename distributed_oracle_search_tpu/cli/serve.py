@@ -173,8 +173,9 @@ def build_frontend(conf: ClusterConfig, args):
     # planner loads the graph lazily on its first query
     from ..traffic import QueryFamilies
     if args.backend == "inproc":
-        families = QueryFamilies(frontend, graph=dispatcher.graph,
-                                 traffic=traffic)
+        families = QueryFamilies(
+            frontend, graph=dispatcher.graph, traffic=traffic,
+            oracle=_mesh_mat_oracle(conf, dispatcher, traffic))
     else:
         from ..data.graph import Graph
         families = QueryFamilies(
@@ -182,6 +183,44 @@ def build_frontend(conf: ClusterConfig, args):
             graph_provider=lambda: Graph.from_xy(conf.xy_file),
             traffic=traffic)
     return frontend, registry, families
+
+
+def _mesh_mat_oracle(conf: ClusterConfig, dispatcher, traffic=None):
+    """``DOS_MESH_MAT``: load a mesh-resident oracle so the ``mat``
+    family answers each row with ONE on-mesh collective
+    (``CPDOracle.query_mat`` — walk + psum join on device) instead of
+    one frontend future per target. Inproc backend only (the oracle
+    needs the full index on the local mesh); any load failure logs and
+    degrades to the fan-out/join path, never a startup outage.
+
+    Disabled under live traffic (``--traffic-dir``): the epoch pump
+    can PROMOTE delta-rebuilt indexes into the dispatcher's engines
+    (``ShardEngine.promote_index``), and this oracle's startup table
+    would keep serving old-regime rows re-priced under new fused
+    weights — mat rows would silently diverge from the pair path, the
+    exact regime promotion exists to eliminate."""
+    from ..utils.env import env_flag
+
+    if not env_flag("DOS_MESH_MAT", False):
+        return None
+    if traffic is not None:
+        log.warning("DOS_MESH_MAT ignored under --traffic-dir: the "
+                    "mesh oracle cannot follow epoch-promoted delta "
+                    "indexes; mat serves via fan-out/join")
+        return None
+    try:
+        from ..models.cpd import CPDOracle
+
+        oracle = CPDOracle(dispatcher.graph, dispatcher.dc)
+        oracle.load(conf.outdir)
+        log.info("DOS_MESH_MAT: mat family serving via on-mesh "
+                 "collectives (index %s)", conf.outdir)
+        return oracle
+    except Exception as e:  # noqa: BLE001 — an optimization path must
+        # not take the serve down with it
+        log.warning("DOS_MESH_MAT: cannot load mesh oracle from %s: %s "
+                    "(mat serves via fan-out/join)", conf.outdir, e)
+        return None
 
 
 def _dc_for(conf: ClusterConfig):

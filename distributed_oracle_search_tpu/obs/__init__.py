@@ -233,6 +233,18 @@ series every resident process exposes):
   xla counter — the fleet-wide signal that ``auto`` actually engaged
   the fused kernel, next to its ``table-search[pallas]/...`` program
   cost capture).
+
+Worker mesh (multi-device sharded execution — one worker driving a
+lane mesh, ``DOS_MESH_DEVICES``; README "Worker mesh"):
+
+* ``mesh_devices`` (gauge) — devices in this worker's local lane mesh
+  (1 = the legacy single-device engine);
+* ``mesh_walk_batches_total`` — table-search batches split across the
+  worker's mesh lanes (per-device bucket subsets under shard_map,
+  bit-identical unsort);
+* ``mesh_collective_seconds`` — on-mesh collective join per mat-family
+  row (``CPDOracle.query_mat``: walk + scatter + psum, replacing the
+  head-side fan-out/join).
 """
 
 from . import device, fleet, metrics, quantiles, trace

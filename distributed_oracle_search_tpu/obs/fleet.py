@@ -232,6 +232,16 @@ def _summarize(status: dict) -> dict:
                 and not isinstance(sec["diff_epoch"], bool)):
             out["diff epoch"] = int(sec["diff_epoch"])
             break
+    # worker-mesh column: lanes per worker (multi-device engines) —
+    # same mixed-schema tolerance: an older worker omits the key (or
+    # ships an odd type) and its row shows a blank, never a crash
+    for sec in (serving, worker):
+        mesh = sec.get("mesh")
+        if (isinstance(mesh, dict)
+                and isinstance(mesh.get("devices"), (int, float))
+                and not isinstance(mesh.get("devices"), bool)):
+            out["mesh"] = int(mesh["devices"])
+            break
     mig = serving.get("migration") or worker.get("migration")
     if isinstance(mig, dict):
         moves = mig.get("moves") if isinstance(mig.get("moves"), list) \
@@ -303,6 +313,19 @@ _KEY_DIRECTIONS = {
     "build_delta_rows_per_sec": "higher",
     "build_pipeline_stall_seconds": "lower",
     "build_stage_overlap_seconds": "higher",
+    # the worker-mesh family (multi-device sharded execution): per-
+    # device-count rates improve UP, the strong-scaling overhead split
+    # improves DOWN, and the multichip smoke is a 0/1 health bit whose
+    # only regression is 1 -> 0 (tolerance 0 below). The
+    # shard_strong_scaling_* scalars pin the PR 13 headline: the W=8
+    # rate regressing vs W=1 was the bug this family measures.
+    "mesh_build_rows_per_sec_d8": "higher",
+    "mesh_walk_queries_per_sec_d8": "higher",
+    "mesh_mat_rows_per_sec_d8": "higher",
+    "shard_strong_scaling_rows_per_sec_w1": "higher",
+    "shard_strong_scaling_rows_per_sec_w8": "higher",
+    "shard_strong_scaling_overhead_w8_seconds": "lower",
+    "multichip_smoke_ok": "higher",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -319,6 +342,8 @@ _KEY_TOLERANCES = {
     # drop means the pass stopped skipping, so gate it tighter than the
     # jittery-link default
     "build_delta_vs_full_ratio": 0.2,
+    # the multichip smoke is pass/fail: ANY drop (1 -> 0) gates
+    "multichip_smoke_ok": 0.0,
 }
 
 

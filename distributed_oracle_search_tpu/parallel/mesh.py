@@ -16,8 +16,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.env import env_cast
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
 WORKER_AXIS = "worker"
 DATA_AXIS = "data"
+#: the WORKER-LOCAL axis: one worker process driving several devices.
+#: Orthogonal to the campaign mesh's (data, worker) axes — a lane mesh
+#: never crosses workers, it splits ONE worker's batches/build chunks
+#: over the devices that worker owns.
+LANE_AXIS = "lane"
 
 
 def make_mesh(n_workers: int | None = None, n_data: int = 1,
@@ -70,6 +80,54 @@ def mesh_from_config(conf) -> Mesh:
             "per worker")
     return make_mesh(n_workers=n_workers,
                      n_data=shape.get(DATA_AXIS, 1))
+
+
+def mesh_devices(avail: int | None = None) -> int:
+    """Resolve the ``DOS_MESH_DEVICES`` knob: how many local devices one
+    worker drives. 1 (the default — unset, malformed, or non-positive)
+    is the legacy single-device engine, byte-identical behavior.
+
+    The resolved count is floored to a power of two (batch pads and
+    build chunks are pow2, so only pow2 lane counts split them evenly)
+    and clamped to the devices actually present — an 8-lane config on a
+    4-device host degrades with a log line, never a crash."""
+    n = env_cast("DOS_MESH_DEVICES", 1, int)
+    if n <= 1:
+        return 1
+    have = len(jax.devices()) if avail is None else int(avail)
+    if n > have:
+        log.warning("DOS_MESH_DEVICES=%d but only %d device(s) present; "
+                    "clamping", n, have)
+        n = have
+    floored = 1 << (max(n, 1).bit_length() - 1)
+    if floored != n:
+        log.warning("DOS_MESH_DEVICES=%d is not a power of two; using "
+                    "%d lanes (pow2 splits keep padded batches even)",
+                    n, floored)
+    return max(floored, 1)
+
+
+def make_worker_mesh(n_lanes: int | None = None,
+                     devices=None) -> Mesh | None:
+    """The worker-LOCAL sub-mesh: a 1-D ``(lane,)`` mesh over the first
+    ``n_lanes`` devices this process owns. ``n_lanes=None`` resolves
+    ``DOS_MESH_DEVICES``; a resolved count of 1 returns ``None`` — the
+    single-device legacy path, so callers gate mesh execution on the
+    return value and an unset knob stays byte-identical."""
+    devices = jax.devices() if devices is None else list(devices)
+    if n_lanes is None:
+        n_lanes = mesh_devices(avail=len(devices))
+    if n_lanes <= 1:
+        return None
+    if n_lanes > len(devices):
+        raise ValueError(
+            f"worker mesh needs {n_lanes} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_lanes]), (LANE_AXIS,))
+
+
+def lane_sharding(mesh: Mesh, rank: int = 1) -> NamedSharding:
+    """Shard axis 0 over the worker's lanes, replicate the rest."""
+    return NamedSharding(mesh, P(LANE_AXIS, *([None] * (rank - 1))))
 
 
 def worker_sharding(mesh: Mesh, rank: int = 1) -> NamedSharding:

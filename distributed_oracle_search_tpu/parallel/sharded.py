@@ -37,7 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import DeviceGraph, table_search_batch
-from .mesh import WORKER_AXIS, DATA_AXIS, replicated
+from .mesh import WORKER_AXIS, DATA_AXIS, LANE_AXIS, replicated
 
 # jax moved shard_map to the top-level namespace after 0.4.x; older
 # releases only ship the experimental spelling, whose replication
@@ -70,7 +70,8 @@ def pad_targets(controller, dtype=np.int32) -> np.ndarray:
 @functools.lru_cache(maxsize=None)
 def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
               with_dists: bool, kind: str = "ell",
-              kernel_sig: tuple | None = None):
+              kernel_sig: tuple | None = None,
+              axis: str = WORKER_AXIS):
     """One compiled sharded builder for all three relaxation kernels.
 
     ``kind`` selects the distance stage: ``"sweep"`` (fast-sweeping grid
@@ -131,10 +132,10 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
             return fm[None], dist[None]
         return fm[None]
 
-    out_spec = P(WORKER_AXIS, None, None)
+    out_spec = P(axis, None, None)
     sm = _shard_map(
         _local, mesh=mesh,
-        in_specs=(P(), *([P()] * n_kernel_ops), P(None, WORKER_AXIS)),
+        in_specs=(P(), *([P()] * n_kernel_ops), P(None, axis)),
         out_specs=(out_spec, out_spec) if with_dists else out_spec,
     )
     return jax.jit(sm)
@@ -143,7 +144,7 @@ def _build_fn(mesh: Mesh, n_workers: int, max_iters: int,
 def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
                      mesh: Mesh, chunk: int = 0,
                      max_iters: int = 0, with_dists: bool = False,
-                     kernel=None):
+                     kernel=None, axis: str = WORKER_AXIS):
     """Build the full sharded CPD: int8 [W, R, N], axis 0 on ``worker``.
 
     ``chunk`` bounds per-device live distance rows (0 = whole shard at
@@ -160,36 +161,44 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
     ``kernel``: optional ``(kind, structure)`` from
     ``models.cpd.pick_build_kernel`` — selects the fast-sweeping /
     shift / ELL distance stage (default ELL).
+
+    ``axis``: the mesh axis the target rows shard over — the campaign
+    mesh's ``worker`` axis by default, or a worker-local mesh's
+    ``lane`` axis (:func:`build_fm_lanes`): the per-target computation
+    is axis-agnostic, only the sharding spec names change.
     """
     w, r = targets_wr.shape
-    if mesh.shape[WORKER_AXIS] != w:
+    if mesh.shape[axis] != w:
         raise ValueError(
-            f"targets rows ({w}) != mesh worker axis "
-            f"({mesh.shape[WORKER_AXIS]})")
+            f"targets rows ({w}) != mesh {axis} axis "
+            f"({mesh.shape[axis]})")
     kind, st = kernel if kernel is not None else ("ell", None)
     if kind == "sweep":
         fn = _build_fn(mesh, w, max_iters, with_dists, kind="sweep",
                        kernel_sig=(st.height, st.width, st.shifts,
-                                   st.n_left))
+                                   st.n_left), axis=axis)
         build = lambda dg_, t_: fn(  # noqa: E731
             dg_, st.wl, st.wr, st.wd, st.wu, st.w_shift, st.src_left,
             st.dst_left, st.w_left, t_)
     elif kind == "shift":
         fn = _build_fn(mesh, w, max_iters, with_dists, kind="shift",
-                       kernel_sig=(st.shifts, st.n, st.k_left))
+                       kernel_sig=(st.shifts, st.n, st.k_left),
+                       axis=axis)
         build = lambda dg_, t_: fn(  # noqa: E731
             dg_, st.w_shift, st.nbr_left, st.w_left, t_)
     elif kind == "ellsplit":
         fn = _build_fn(mesh, w, max_iters, with_dists, kind="ellsplit",
-                       kernel_sig=(st.n, st.k0, len(st.u_ov)))
+                       kernel_sig=(st.n, st.k0, len(st.u_ov)),
+                       axis=axis)
         build = lambda dg_, t_: fn(  # noqa: E731
             dg_, st.nbr0, st.w0, st.u_ov, st.v_ov, st.w_ov, t_)
     elif kind == "frontier":
         fn = _build_fn(mesh, w, max_iters, with_dists, kind="frontier",
-                       kernel_sig=(st.n, st.f, st.delta, st.s_unroll))
+                       kernel_sig=(st.n, st.f, st.delta, st.s_unroll),
+                       axis=axis)
         build = lambda dg_, t_: fn(dg_, st.in_nbr, t_)  # noqa: E731
     else:
-        build = _build_fn(mesh, w, max_iters, with_dists)
+        build = _build_fn(mesh, w, max_iters, with_dists, axis=axis)
     if chunk <= 0 or chunk >= r:
         chunks = [targets_wr]
     else:
@@ -210,6 +219,142 @@ def build_fm_sharded(dg: DeviceGraph, targets_wr: np.ndarray,
         return fm[:, :r], dist[:, :r]
     fm = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
     return fm[:, :r]
+
+
+# ------------------------------------------------------- worker lanes
+#
+# Worker-LOCAL multi-device execution (the ``lane`` axis,
+# ``parallel.mesh.make_worker_mesh``): one worker process drives several
+# devices, splitting its own batches/build chunks across them. Unlike
+# the campaign mesh above, nothing here crosses shards — the fm rows
+# are this ONE shard's and replicate over lanes; only the query/target
+# axis splits. Every lane function is bit-identical to its
+# single-device twin (per-query/per-target computations are
+# independent; tests/test_mesh.py pins 1/2/4/8 lanes).
+
+def build_fm_lanes(dg: DeviceGraph, pad: np.ndarray, mesh: Mesh,
+                   kind: str, structure, max_iters: int = 0):
+    """One build chunk's target pad (int32 ``[C]``, -1-padded) computed
+    across the worker's lanes: lane l builds the contiguous rows
+    ``pad[l*C/L:(l+1)*C/L]``. Returns the async device fm block
+    ``[C, N]`` in original target order — the same contract as the
+    single-device chunk compute, so the pipelined build's stager/flush
+    machinery is unchanged. ``C`` must divide by the lane count
+    (callers gate; pads are fixed pow2-friendly shapes)."""
+    lanes = mesh.shape[LANE_AXIS]
+    c = int(np.asarray(pad).shape[0])
+    targets_lr = np.asarray(pad, np.int32).reshape(lanes, c // lanes)
+    fm = build_fm_sharded(dg, targets_lr, mesh, chunk=0,
+                          max_iters=max_iters,
+                          kernel=(kind, structure), axis=LANE_AXIS)
+    return fm.reshape(c, -1)
+
+
+@functools.lru_cache(maxsize=None)
+def _lane_walk_fn(mesh: Mesh, max_steps: int, k_moves: int,
+                  kernel: str):
+    """One compiled lane-split walk: queries ``[L, Qb]`` sharded over
+    ``lane``, the shard's fm replicated. ``kernel`` joins the cache key
+    exactly like ``_query_fn``'s — each lane runs its bucket subset
+    through the Pallas or XLA walk unchanged."""
+    q2 = P(LANE_AXIS, None)
+
+    def _local(dg, fm, rows, s, t, valid, w_pad):
+        shape = s.shape
+        if kernel == "pallas":
+            from ..ops.pallas_walk import pallas_walk_batch as walk
+        else:
+            walk = table_search_batch
+        cost, plen, fin = walk(
+            dg, fm, rows.reshape(-1), s.reshape(-1), t.reshape(-1),
+            w_pad, valid=valid.reshape(-1), k_moves=k_moves,
+            max_steps=max_steps)
+        return (cost.reshape(shape), plen.reshape(shape),
+                fin.reshape(shape))
+
+    sm = _shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(), P(), q2, q2, q2, q2, P()),
+        out_specs=(q2, q2, q2),
+    )
+    return jax.jit(sm)
+
+
+def walk_lanes(dg: DeviceGraph, fm, t_rows, s, t, valid, w_pad,
+               mesh: Mesh, k_moves: int = -1, max_steps: int = 0,
+               kernel: str = "xla"):
+    """Split one worker's walk batch across its lanes.
+
+    Flat ``[Q]`` inputs (the engine's est-sorted, pow2-padded batch);
+    ``Q`` must divide by the lane count (the engine gates). Lane l
+    walks the contiguous slice ``[l*Q/L, (l+1)*Q/L)`` — contiguous in
+    the sorted order, so each lane's auto-bucketing
+    (``pick_buckets``) sees the same monotone length profile the
+    single-device kernel does, and results are bucket-invariant
+    (pinned), hence bit-identical after the flat reshape back.
+    Returns ``(cost, plen, finished)`` flat ``[Q]`` device arrays."""
+    lanes = mesh.shape[LANE_AXIS]
+    q = int(np.asarray(s).shape[0])
+    qs = NamedSharding(mesh, P(LANE_AXIS, None))
+    packed = tuple(np.asarray(a).reshape(lanes, q // lanes)
+                   for a in (t_rows, s, t, valid))
+    # ONE device_put for the whole pack (same rationale as
+    # query_sharded: each separate transfer pays a fixed round trip)
+    args = jax.device_put(packed, qs)
+    fn = _lane_walk_fn(mesh, max_steps, int(k_moves), str(kernel))
+    cost, plen, fin = fn(dg, fm, *args, w_pad)
+    return cost.reshape(q), plen.reshape(q), fin.reshape(q)
+
+
+@functools.lru_cache(maxsize=None)
+def _mat_fn(mesh: Mesh, k_out: int, max_steps: int):
+    """One-to-many ETA row with the JOIN ON MESH: each shard walks its
+    routed slice, scatters its answers into a dense ``[k_out]`` row at
+    the slot positions the router assigned, and a ``psum`` over both
+    mesh axes assembles the complete row as a collective — no head-side
+    fan-out/join, no per-target result plumbing."""
+    q3 = P(DATA_AXIS, WORKER_AXIS, None)
+
+    def _local(dg, fm_local, rows, s, t, valid, slots, w_pad):
+        v = valid.reshape(-1)
+        cost, _plen, fin = table_search_batch(
+            dg, fm_local[0], rows.reshape(-1), s.reshape(-1),
+            t.reshape(-1), w_pad, valid=v, k_moves=-1,
+            max_steps=max_steps)
+        # scatter-add into [k_out + 1]: pad slots dump into the extra
+        # slot; every real target index lives in exactly ONE (d, w, q)
+        # slot fleet-wide, so the psum is a disjoint union, not a sum
+        idx = jnp.where(v, slots.reshape(-1), k_out)
+        row_c = jnp.zeros(k_out + 1, jnp.int32).at[idx].add(
+            jnp.where(v, cost, 0))
+        row_f = jnp.zeros(k_out + 1, jnp.int32).at[idx].add(
+            fin.astype(jnp.int32))
+        row_c = jax.lax.psum(row_c, (DATA_AXIS, WORKER_AXIS))
+        row_f = jax.lax.psum(row_f, (DATA_AXIS, WORKER_AXIS))
+        return row_c[:k_out], row_f[:k_out] > 0
+
+    sm = _shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS, None, None), q3, q3, q3, q3, q3,
+                  P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sm)
+
+
+def query_mat_sharded(dg: DeviceGraph, fm_wrn, t_rows, s, t, valid,
+                      slots, w_pad, mesh: Mesh, k_out: int,
+                      max_steps: int = 0):
+    """Answer one ``mat`` family row (one source, ``k_out`` targets)
+    with on-mesh collectives: routed ``[D, W, Q]`` inputs as in
+    :func:`query_sharded` plus ``slots`` (each routed slot's position
+    in the output row, -1 on padding). Returns ``(cost [k_out] int32,
+    finished [k_out] bool)`` — already in target order, replicated, so
+    the host reads one device and does no join at all."""
+    qs = NamedSharding(mesh, P(DATA_AXIS, WORKER_AXIS, None))
+    args = jax.device_put((t_rows, s, t, valid, slots), qs)
+    fn = _mat_fn(mesh, int(k_out), max_steps)
+    return fn(dg, fm_wrn, *args, jnp.asarray(w_pad))
 
 
 # ----------------------------------------------------------- cost tables

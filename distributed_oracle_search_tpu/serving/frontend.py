@@ -315,6 +315,17 @@ class ServingFrontend:
                 "max_bytes": self.cache.max_bytes,
             },
         }
+        # worker mesh shape (DOS_MESH_DEVICES resolution) — reported
+        # best-effort: a head whose backend cannot resolve devices
+        # (host-wire frontend with no local accelerator runtime) shows
+        # the single-device default rather than erroring the page
+        try:
+            from ..parallel.mesh import mesh_devices
+            out["mesh"] = {"devices": int(mesh_devices()),
+                           "axis": "lane"}
+        except Exception as e:  # noqa: BLE001 — statusz must render;
+            # the mesh cell degrades to absent (blank in `dos-obs top`)
+            log.debug("mesh shape unavailable for statusz: %s", e)
         if self.membership is not None:
             mstat = self.membership.statusz()
             if "migration" in mstat:

@@ -107,6 +107,19 @@ class MatrixResult:
         self.costs = [int(r.cost) if r.ok and r.finished else -1
                       for r in results]
 
+    @classmethod
+    def from_mesh(cls, s: int, targets, costs, finished):
+        """Build from an on-mesh ``query_mat`` row (no per-target
+        result objects — the join already happened on device): the
+        encoded MAT sentence is identical to the fan-out path's."""
+        out = cls.__new__(cls)
+        out.s = int(s)
+        out.targets = [int(t) for t in targets]
+        out.results = []
+        out.costs = [int(c) if f else -1
+                     for c, f in zip(costs, finished)]
+        return out
+
     @property
     def ok(self) -> bool:
         return all(r.ok for r in self.results)
@@ -165,15 +178,26 @@ class QueryFamilies:
     sees an alt query never loads it). ``traffic`` (a
     :class:`~.epochs.DiffEpochManager`) prices first edges under the
     LIVE fusion; without it, the frontend's static diff file is read
-    once per diff and overlaid."""
+    once per diff and overlaid.
+
+    ``oracle`` (a mesh-resident :class:`~..models.cpd.CPDOracle`):
+    the ``mat`` family's ON-MESH path — one ``query_mat`` collective
+    per row (walk + scatter + psum join on device) instead of one
+    frontend future per target through queue/batcher/dispatcher. The
+    row is priced under the frontend's CURRENT diff (live fusion
+    included — the diff file is re-read per change, cached), so the
+    MAT sentence is identical to the fan-out path's; without an
+    oracle the fan-out/join path serves as before."""
 
     def __init__(self, frontend, graph=None, graph_provider=None,
-                 traffic=None):
+                 traffic=None, oracle=None):
         self.frontend = frontend
         self._graph = graph
         self._graph_provider = graph_provider
         self.traffic = traffic
+        self.oracle = oracle
         self._overlay_cache: tuple[str, dict] | None = None
+        self._mat_weights: tuple[str, object] | None = None
 
     # ------------------------------------------------------------ helpers
     def graph(self):
@@ -201,9 +225,33 @@ class QueryFamilies:
             self._overlay_cache = cached
         return int(cached[1].get((int(u), int(v)), base))
 
+    def _mat_query_weights(self, diff: str):
+        """The edge-weight array ``query_mat`` prices the row under —
+        the frontend's current diff (None = free flow), read once per
+        diff change."""
+        if diff in ("-", "", None):
+            return None
+        cached = self._mat_weights
+        if cached is None or cached[0] != diff:
+            w = self.oracle.graph.weights_with_diff(read_diff(diff))
+            cached = (diff, w)
+            self._mat_weights = cached
+        return cached[1]
+
     # ----------------------------------------------------------- families
     def matrix(self, s: int, targets) -> CompositeFuture:
         M_MATRIX.inc()
+        if self.oracle is not None:
+            # on-mesh path: one collective answers the whole row. The
+            # diff path doubles as the oracle's device-buffer cache
+            # key, so rows under one diff share one weights upload.
+            diff = self.frontend.diff
+            cost, fin = self.oracle.query_mat(
+                int(s), [int(t) for t in targets],
+                w_query=self._mat_query_weights(diff),
+                w_key=None if diff in ("-", "", None) else str(diff))
+            res = MatrixResult.from_mesh(s, targets, cost, fin)
+            return CompositeFuture([], lambda _results: res)
         futs = [self.frontend.submit(int(s), int(t)) for t in targets]
         return CompositeFuture(
             futs, lambda results: MatrixResult(s, targets, results))

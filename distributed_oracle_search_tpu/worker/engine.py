@@ -73,6 +73,14 @@ M_WALK_XLA = obs_metrics.counter(
     "walk_xla_batches_total",
     "table-search batches answered by the XLA reference walk "
     "(includes pallas-requested batches that fell back on VMEM fit)")
+M_MESH_DEVICES = obs_metrics.gauge(
+    "mesh_devices",
+    "devices in this worker's local lane mesh (DOS_MESH_DEVICES "
+    "resolution; 1 = the legacy single-device engine)")
+M_MESH_WALK = obs_metrics.counter(
+    "mesh_walk_batches_total",
+    "table-search batches split across the worker's mesh lanes "
+    "(per-device bucket subsets under shard_map, bit-identical unsort)")
 
 
 def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
@@ -157,9 +165,10 @@ def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
 class ShardEngine:
     def __init__(self, graph: Graph, dc: DistributionController, wid: int,
                  outdir: str, alg: str = "table-search",
-                 shard: int | None = None, replica: int | None = None):
-        import jax.numpy as jnp
+                 shard: int | None = None, replica: int | None = None,
+                 mesh=None):
         from ..ops import DeviceGraph
+        from ..parallel.mesh import LANE_AXIS, make_worker_mesh
 
         if alg not in ("table-search", "astar"):
             raise ValueError(f"unknown algorithm {alg!r}")
@@ -167,6 +176,15 @@ class ShardEngine:
         self.graph = graph
         self.dc = dc
         self.wid = wid
+        #: worker-local lane mesh (``DOS_MESH_DEVICES``; an explicit
+        #: ``mesh=`` ctor arg wins): the engine drives EVERY lane —
+        #: walk batches split into per-device bucket subsets, the fm
+        #: table replicated across lanes. ``None`` = the legacy
+        #: single-device engine, byte-identical behavior.
+        self.mesh = mesh if mesh is not None else make_worker_mesh()
+        self.n_lanes = (self.mesh.shape[LANE_AXIS]
+                        if self.mesh is not None else 1)
+        M_MESH_DEVICES.set(self.n_lanes)
         #: base index directory the rows loaded from — where epoch-
         #: tagged delta-rebuilt indexes (``models.cpd.epoch_index_dir``)
         #: are discovered for background promotion
@@ -196,11 +214,22 @@ class ShardEngine:
         else:
             self.replica = (dc.replica_rank(self.shard, wid)
                             if self.shard != wid else 0)
+        #: REPLICA LANE: with a lane mesh, replica rank r pins to mesh
+        #: lane ``r % L`` — each hosted replica serves from its OWN
+        #: device, so an R>1 deployment on one host gives the breaker/
+        #: hedge/failover paths a real second compute target instead of
+        #: R engines time-slicing one chip (what let the TPU backend's
+        #: R=1 pin lift, ``cli.process_query``). The primary (rank 0)
+        #: keeps the whole mesh and lane-splits its batches instead.
+        self._lane_device = None
+        if self.mesh is not None and self.replica:
+            self._lane_device = list(self.mesh.devices.flat)[
+                self.replica % self.n_lanes]
         #: device-batch rows per A* chunk; the deadline is checked
         #: between chunks (first chunk always runs)
         self.astar_chunk = 1024
         if alg == "table-search":  # astar needs no first-move shard
-            self.fm = jnp.asarray(load_shard_rows(
+            self.fm = self._place(load_shard_rows(
                 outdir, self.shard, dc=dc, graph=graph,
                 replica=self.replica))
             owned = dc.owned(self.shard)
@@ -211,7 +240,13 @@ class ShardEngine:
                     "partition mismatch")
         else:
             self.fm = None
-        self.dg = DeviceGraph.from_graph(graph)
+        dg = DeviceGraph.from_graph(graph)
+        if self._lane_device is not None or self._lane_split:
+            # graph arrays follow the fm placement: pinned to the
+            # replica's lane, or replicated across the lanes the
+            # primary's shard_map walks read from
+            dg = DeviceGraph(*(self._place(a) for a in dg))
+        self.dg = dg
         #: per-diff device weight buffers, LRU-bounded: the live-traffic
         #: plane swaps fused diffs every few seconds, and an unbounded
         #: cache would pin one HBM weights array per epoch forever. The
@@ -238,6 +273,34 @@ class ShardEngine:
         #: one log line per engine when a pallas-requested batch falls
         #: back to XLA on the VMEM-fit check (not one per batch)
         self._walk_fallback_logged = False
+
+    # ------------------------------------------------------------- mesh
+    @property
+    def _lane_split(self) -> bool:
+        """Whether this engine splits its walk batches over mesh lanes:
+        the PRIMARY engine of a mesh-driving worker does; replica
+        engines pin to their own lane device instead; astar keeps the
+        single-device batched kernel (its chunked deadline semantics
+        are host-driven)."""
+        return (self.mesh is not None and not self.replica
+                and self.alg == "table-search")
+
+    def _place(self, arr):
+        """Device placement under the worker mesh: replica engines pin
+        to their lane's device, the lane-splitting primary replicates
+        across lanes (the shard's rows must be visible to every lane —
+        any query's target row can be any row), and without a mesh this
+        is the plain default-device upload."""
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.mesh import replicated
+
+        if self._lane_device is not None:
+            return jax.device_put(np.asarray(arr), self._lane_device)
+        if self._lane_split:
+            return jax.device_put(np.asarray(arr),
+                                  replicated(self.mesh))
+        return jnp.asarray(arr)
 
     # ---------------------------------------------------------- promotion
     def _fm_for(self, difffile: str):
@@ -276,8 +339,6 @@ class ShardEngine:
         optimal new paths), so cache entries keyed to that diff epoch
         that were computed before the promotion must be invalidated —
         the serving cache's epoch-scoped flush is the tool."""
-        import jax.numpy as jnp
-
         if self.alg != "table-search":
             return False
         try:
@@ -312,7 +373,7 @@ class ShardEngine:
                             "already-promoted epoch %d", self.wid,
                             epoch, cur[0])
                 return False
-            self._fm_promoted = (int(epoch), jnp.asarray(rows))
+            self._fm_promoted = (int(epoch), self._place(rows))
             self.index_epoch = int(epoch)
         log.info("worker %d: promoted shard %d to diff-epoch %d index "
                  "(%s)", self.wid, self.shard, epoch, new_outdir)
@@ -340,7 +401,6 @@ class ShardEngine:
 
     # ------------------------------------------------------------ weights
     def _weights_for(self, difffile: str, no_cache: bool):
-        import jax.numpy as jnp
         if difffile in self._weight_cache and not no_cache:
             self._weight_cache.move_to_end(difffile)
             return self._weight_cache[difffile]
@@ -348,7 +408,9 @@ class ShardEngine:
             w_pad = self.dg.w_pad
         else:
             w = self.graph.weights_with_diff(read_diff(difffile))
-            w_pad = jnp.asarray(self.graph.padded_weights(w), jnp.int32)
+            # placement follows the fm table (lane-replicated / pinned)
+            w_pad = self._place(np.asarray(
+                self.graph.padded_weights(w), np.int32))
         if no_cache:
             self._weight_cache.clear()
         else:
@@ -436,8 +498,12 @@ class ShardEngine:
         unsort = np.argsort(order)
         qsorted = uniq[order]
         # pad to the next power of two: stable shapes, no recompiles as the
-        # per-worker batch size shifts between campaigns
+        # per-worker batch size shifts between campaigns. A lane-mesh
+        # engine pads at least to the lane count so EVERY batch splits
+        # evenly over the mesh (the extra rows are valid=False lanes)
         qpad = 1 << (nu - 1).bit_length()
+        if self._lane_split:
+            qpad = max(qpad, self.n_lanes)
         s = np.zeros(qpad, np.int32)
         t = np.zeros(qpad, np.int32)
         valid = np.zeros(qpad, bool)
@@ -478,9 +544,13 @@ class ShardEngine:
             call_q = (self.astar_chunk
                       if config.time and qpad > self.astar_chunk
                       else qpad)
+            # lane-split batches: each device walks call_q / L queries,
+            # so the VMEM-fit check sees the PER-LANE working set (the
+            # same division CPDOracle._walk_kernel applies per shard)
             kernel, why = choose_walk_kernel(
                 self.dg.n, self.dg.k, int(self.dg.w_pad.shape[0]) - 1,
-                call_q)
+                max(call_q // self.n_lanes, 1) if self._lane_split
+                else call_q)
             if why and not self._walk_fallback_logged:
                 log.warning("%s", why)
                 self._walk_fallback_logged = True
@@ -489,6 +559,11 @@ class ShardEngine:
             (M_WALK_PALLAS if kernel == "pallas" else M_WALK_XLA).inc()
             jit_key = (self.alg, shape_key, config.k_moves, extracting,
                        config.sig_k if config.sig_k > 0 else 0, kernel)
+            if self._lane_split:
+                # lane programs compile separately from single-device
+                # ones (and per lane count): bookkeeping stays split
+                jit_key = jit_key + (("lanes", self.n_lanes),)
+                M_MESH_WALK.inc()
         first_call = jit_key not in self._jit_seen
         if self.alg == "astar":
             deadline = t1 + config.time / 1e9 if config.time else None
@@ -503,13 +578,28 @@ class ShardEngine:
                 **counters, t_receive=t1 - t0, t_astar=t2 - t1,
                 t_search=t2 - t0)
             return cost, plen, fin, stats
+        def run_walk(rows_h, s_h, t_h, valid_h):
+            """One walk call: split across the worker's mesh lanes when
+            active (contiguous per-lane subsets of the est-sorted batch
+            under shard_map — each lane runs its own bucket grid through
+            the selected kernel unchanged), the plain single-device
+            kernel otherwise. Answers are bit-identical either way; the
+            unsort below never changes."""
+            if self._lane_split:
+                from ..parallel.sharded import walk_lanes
+
+                return walk_lanes(
+                    self.dg, fm_tbl, rows_h, s_h, t_h, valid_h, w_pad,
+                    self.mesh, k_moves=config.k_moves, kernel=kernel)
+            return walk_fn(
+                self.dg, fm_tbl, jnp.asarray(rows_h), jnp.asarray(s_h),
+                jnp.asarray(t_h), w_pad, valid=jnp.asarray(valid_h),
+                k_moves=config.k_moves)
+
         deadline = t1 + config.time / 1e9 if config.time else None
         for _ in range(max(config.itrs, 1)):
             if deadline is None or qpad <= self.astar_chunk:
-                cost, plen, fin = walk_fn(
-                    self.dg, fm_tbl, jnp.asarray(rows), jnp.asarray(s),
-                    jnp.asarray(t), w_pad, valid=jnp.asarray(valid),
-                    k_moves=config.k_moves)
+                cost, plen, fin = run_walk(rows, s, t, valid)
                 jax.block_until_ready(fin)
             else:
                 # ns budget truncates INSIDE the batch (reference
@@ -540,11 +630,7 @@ class ShardEngine:
                     if off and time.perf_counter() > deadline:
                         break
                     sl = slice(off, off + ch)
-                    outs = walk_fn(
-                        self.dg, fm_tbl, jnp.asarray(rows[sl]),
-                        jnp.asarray(s[sl]), jnp.asarray(t[sl]), w_pad,
-                        valid=jnp.asarray(valid[sl]),
-                        k_moves=config.k_moves)
+                    outs = run_walk(rows[sl], s[sl], t[sl], valid[sl])
                     if pending is not None:
                         _land(pending)
                     pending = (sl, outs)
@@ -577,7 +663,11 @@ class ShardEngine:
             self.last_paths = (nodes, moves)
         t2 = time.perf_counter()
         self._finish_search(jit_key, first_call, nq, t2 - t1)
-        if first_call and obs_device.enabled():
+        # lane-split batches skip the capture: the AOT analysis below
+        # lowers the SINGLE-DEVICE program, which the mesh path never
+        # ran — capturing it would book a fresh compile of a
+        # never-executed shape (the exact thing the cap_n logic avoids)
+        if first_call and obs_device.enabled() and not self._lane_split:
             # one XLA cost/memory analysis per compiled-program key
             # (FLOPs, bytes accessed, HBM footprint -> /metrics gauges +
             # BENCH_DETAIL.json): the AOT re-lower is cheap and runs

@@ -667,6 +667,14 @@ class FifoServer:
         out["alg"] = self.alg
         out["command_fifo"] = self.command_fifo
         out["shard"] = self.wid
+        # worker mesh shape: how many local devices this worker's
+        # engine drives (1 = legacy single-device). Older workers omit
+        # the key; `dos-obs top` renders a blank, never a crash.
+        eng = self.engine
+        out["mesh"] = {
+            "devices": int(getattr(eng, "n_lanes", 1) or 1),
+            "axis": "lane",
+        }
         out["replica_shards_loaded"] = sorted(
             s for s in self._replica_engines if s != self.wid)
         if self.dc.replication > 1:

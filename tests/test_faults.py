@@ -265,6 +265,14 @@ def test_server_health_reflects_failures(tmp_path, monkeypatch):
     s = _bare_server(tmp_path, "hstate")
     answer = str(tmp_path / "hstate.answer")
     os.mkfifo(answer)
+    # the ping reply gets its OWN fifo, mirroring the production probe
+    # protocol (transport.fifo.probe mints a unique answer fifo per
+    # probe): re-opening a shared reply fifo races the server's
+    # previous-reply writer close — the reader can connect to the old
+    # fd and read EOF before the new reply's writer opens (the PR 2
+    # stale-reply race class this test used to win by scheduler luck)
+    ping_answer = str(tmp_path / "hstate.ping.answer")
+    os.mkfifo(ping_answer)
     th = _serve(s)
     try:
         with open(s.command_fifo, "w") as f:     # bare server: FAILs
@@ -272,8 +280,8 @@ def test_server_health_reflects_failures(tmp_path, monkeypatch):
         with open(answer) as f:
             assert f.readline().strip() == "FAIL"
         with open(s.command_fifo, "w") as f:
-            f.write(f"__DOS_PING__ {answer}\n")
-        with open(answer) as f:
+            f.write(f"__DOS_PING__ {ping_answer}\n")
+        with open(ping_answer) as f:
             st = HealthStatus.from_json(f.readline())
         assert st.batches == 1 and st.batch_failures == 1
         assert st.last_error != ""

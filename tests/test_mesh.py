@@ -1,0 +1,443 @@
+"""Worker-mesh parity suite: one worker, one mesh (DOS_MESH_DEVICES).
+
+The mesh engine must be invisible in the answers: every lane count in
+{1, 2, 4, 8} (the conftest's 8 virtual CPU devices) must produce
+BIT-identical results to the single-device engine across the walk
+(both kernels — XLA and the Pallas-fused one in interpret mode), the
+lane-parallel CPD build (same block bytes, same digests), and the
+``mat`` family's on-mesh collective join. ``DOS_MESH_DEVICES`` unset
+or 1 is the legacy path — no mesh object, no mesh counters moving.
+"""
+
+import glob
+import hashlib
+import json
+import os
+import types
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import synth_diff, synth_scenario
+from distributed_oracle_search_tpu.data.formats import write_diff
+from distributed_oracle_search_tpu.models.cpd import (
+    CPDOracle, build_worker_shard,
+)
+from distributed_oracle_search_tpu.obs import fleet
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel.mesh import (
+    LANE_AXIS, make_mesh, make_worker_mesh, mesh_devices,
+)
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.traffic.families import QueryFamilies
+from distributed_oracle_search_tpu.transport.wire import RuntimeConfig
+from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+pytestmark = pytest.mark.mesh
+
+LANES = (1, 2, 4, 8)
+
+
+@pytest.fixture(scope="module")
+def dc1(toy_graph):
+    return DistributionController("tpu", None, 1, toy_graph.n)
+
+
+@pytest.fixture(scope="module")
+def shard_dir(toy_graph, dc1, tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("mesh-shard"))
+    build_worker_shard(toy_graph, dc1, 0, d, chunk=16)
+    return d
+
+
+@pytest.fixture(scope="module")
+def diff_file(toy_graph, tmp_path_factory):
+    d = tmp_path_factory.mktemp("mesh-diff")
+    path = str(d / "t.diff")
+    write_diff(path, *synth_diff(toy_graph, frac=0.3, seed=3))
+    return path
+
+
+@pytest.fixture(scope="module")
+def walk_queries(toy_graph, toy_queries):
+    """Scenario plus the awkward rows: zero-length (s==t) and
+    duplicate pairs — the dedup/unsort machinery must survive lanes."""
+    q = np.asarray(toy_queries, np.int64)
+    extra = np.array([[3, 3], [0, 0], q[0].tolist(), q[0].tolist(),
+                      q[5].tolist()], np.int64)
+    return np.concatenate([q, extra], axis=0)
+
+
+@pytest.fixture(scope="module")
+def baseline(toy_graph, dc1, shard_dir, walk_queries, diff_file):
+    """Single-device engine answers: free-flow and diffed."""
+    eng = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    assert eng.mesh is None        # conftest env carries no mesh knob
+    rc = RuntimeConfig()
+    free = eng.answer(walk_queries, rc)[:3]
+    diffed = eng.answer(walk_queries, rc, diff_file)[:3]
+    return free, diffed
+
+
+def _lane_engine(monkeypatch, lanes, *args, **kwargs):
+    monkeypatch.setenv("DOS_MESH_DEVICES", str(lanes))
+    eng = ShardEngine(*args, **kwargs)
+    assert eng.n_lanes == lanes
+    assert (eng.mesh is None) == (lanes == 1)
+    return eng
+
+
+# ------------------------------------------------------ knob resolution
+
+def test_mesh_devices_resolution(monkeypatch):
+    monkeypatch.delenv("DOS_MESH_DEVICES", raising=False)
+    assert mesh_devices() == 1
+    for raw, want in (("1", 1), ("0", 1), ("-3", 1), ("bogus", 1),
+                      ("2", 2), ("3", 2), ("8", 8), ("64", 8)):
+        monkeypatch.setenv("DOS_MESH_DEVICES", raw)
+        assert mesh_devices() == want, (raw, want)
+
+
+def test_make_worker_mesh_legacy_is_none(monkeypatch):
+    monkeypatch.delenv("DOS_MESH_DEVICES", raising=False)
+    assert make_worker_mesh() is None
+    monkeypatch.setenv("DOS_MESH_DEVICES", "1")
+    assert make_worker_mesh() is None
+    monkeypatch.setenv("DOS_MESH_DEVICES", "4")
+    mesh = make_worker_mesh()
+    assert mesh is not None and mesh.shape[LANE_AXIS] == 4
+
+
+# -------------------------------------------------------- walk parity
+
+@pytest.mark.parametrize("lanes", LANES)
+def test_walk_parity_xla(monkeypatch, toy_graph, dc1, shard_dir,
+                         walk_queries, diff_file, baseline, lanes):
+    """Mesh sizes 1/2/4/8 bit-identical to the single-device engine,
+    free-flow AND diffed, duplicates/zero-length included."""
+    eng = _lane_engine(monkeypatch, lanes, toy_graph, dc1, 0, shard_dir)
+    rc = RuntimeConfig()
+    free, diffed = baseline
+    for want, got in ((free, eng.answer(walk_queries, rc)[:3]),
+                      (diffed,
+                       eng.answer(walk_queries, rc, diff_file)[:3])):
+        for a, b in zip(want, got):
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("lanes", (2, 8))
+def test_walk_parity_pallas_interpret(monkeypatch, toy_graph, dc1,
+                                      shard_dir, walk_queries,
+                                      baseline, lanes):
+    """The Pallas-fused kernel runs per lane unchanged (interpret mode
+    on CPU) — still bit-identical to the XLA single-device answers."""
+    monkeypatch.setenv("DOS_WALK_KERNEL", "pallas")
+    eng = _lane_engine(monkeypatch, lanes, toy_graph, dc1, 0, shard_dir)
+    got = eng.answer(walk_queries, RuntimeConfig())[:3]
+    for a, b in zip(baseline[0], got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_walk_tiny_batch_pads_to_lanes(monkeypatch, toy_graph, dc1,
+                                       shard_dir, walk_queries,
+                                       baseline):
+    """A batch smaller than the lane count pads up (valid=False lanes)
+    instead of falling off the mesh path or crashing."""
+    eng = _lane_engine(monkeypatch, 8, toy_graph, dc1, 0, shard_dir)
+    base = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    rc = RuntimeConfig()
+    for a, b in zip(base.answer(walk_queries[:2], rc)[:3],
+                    eng.answer(walk_queries[:2], rc)[:3]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_walk_deadline_chunked_under_lanes(monkeypatch, toy_graph, dc1,
+                                           shard_dir, walk_queries):
+    """The ns-budget chunked path splits each chunk over lanes; a
+    generous budget answers everything, bit-identical."""
+    base = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    eng = _lane_engine(monkeypatch, 4, toy_graph, dc1, 0, shard_dir)
+    base.astar_chunk = eng.astar_chunk = 16       # force chunking
+    rc = RuntimeConfig(time=10**13)
+    for a, b in zip(base.answer(walk_queries, rc)[:3],
+                    eng.answer(walk_queries, rc)[:3]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_extract_and_sig_under_lanes(monkeypatch, toy_graph, dc1,
+                                     shard_dir, walk_queries):
+    """--extract path prefixes and sig_k signatures are unchanged by
+    the lane split (extraction runs on the lane-replicated table)."""
+    base = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    eng = _lane_engine(monkeypatch, 4, toy_graph, dc1, 0, shard_dir)
+    rc = RuntimeConfig(extract=True, k_moves=6)
+    for a, b in zip(base.answer(walk_queries, rc)[:3],
+                    eng.answer(walk_queries, rc)[:3]):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(base.last_paths[0], eng.last_paths[0])
+    np.testing.assert_array_equal(base.last_paths[1], eng.last_paths[1])
+
+
+def test_mesh_metrics_move_only_on_mesh(monkeypatch, toy_graph, dc1,
+                                        shard_dir, walk_queries):
+    def _counters():
+        snap = obs_metrics.REGISTRY.snapshot()
+        return (snap["counters"].get("mesh_walk_batches_total", 0),
+                snap["gauges"].get("mesh_devices", 0))
+
+    monkeypatch.delenv("DOS_MESH_DEVICES", raising=False)
+    legacy = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    before, gauge = _counters()
+    assert gauge == 1                       # legacy engine reports 1
+    legacy.answer(walk_queries, RuntimeConfig())
+    assert _counters()[0] == before         # no mesh batches booked
+    eng = _lane_engine(monkeypatch, 4, toy_graph, dc1, 0, shard_dir)
+    eng.answer(walk_queries, RuntimeConfig())
+    after, gauge = _counters()
+    assert after > before and gauge == 4
+
+
+# -------------------------------------------------------- build parity
+
+def _digests(d):
+    return {os.path.basename(p):
+            hashlib.md5(open(p, "rb").read()).hexdigest()
+            for p in glob.glob(os.path.join(d, "*.npy"))}
+
+
+@pytest.mark.parametrize("lanes", (2, 4, 8))
+def test_build_parity(monkeypatch, toy_graph, dc1, shard_dir, tmp_path,
+                      lanes):
+    """Lane-parallel build chunks write byte-identical block files."""
+    monkeypatch.setenv("DOS_MESH_DEVICES", str(lanes))
+    d = str(tmp_path / f"lanes{lanes}")
+    build_worker_shard(toy_graph, dc1, 0, d, chunk=16)
+    assert _digests(d) == _digests(shard_dir)
+
+
+def test_build_indivisible_chunk_degrades(monkeypatch, toy_graph, dc1,
+                                          shard_dir, tmp_path):
+    """A chunk the lane count does not divide falls back to the
+    single-device compute — same bytes, no crash."""
+    monkeypatch.setenv("DOS_MESH_DEVICES", "8")
+    d = str(tmp_path / "odd")
+    build_worker_shard(toy_graph, dc1, 0, d, chunk=12)   # 12 % 8 != 0
+    d_ref = str(tmp_path / "odd-ref")
+    monkeypatch.delenv("DOS_MESH_DEVICES")
+    build_worker_shard(toy_graph, dc1, 0, d_ref, chunk=12)
+    assert _digests(d) == _digests(d_ref)
+
+
+def test_build_ctx_reuse(monkeypatch, toy_graph, dc1, tmp_path):
+    """The shared compute ctx (bench hoist) caches the DeviceGraph and
+    kernel pick across calls — and a second build through the same ctx
+    still writes identical blocks."""
+    ctx = {}
+    d1 = str(tmp_path / "c1")
+    build_worker_shard(toy_graph, dc1, 0, d1, chunk=16, ctx=ctx)
+    dg_first = ctx["dg"]
+    d2 = str(tmp_path / "c2")
+    build_worker_shard(toy_graph, dc1, 0, d2, chunk=16, ctx=ctx)
+    assert ctx["dg"] is dg_first
+    assert _digests(d1) == _digests(d2)
+
+
+# ------------------------------------------------------- replica lanes
+
+def test_replica_lane_pinning(monkeypatch, toy_graph, dc1, shard_dir,
+                              walk_queries, baseline):
+    """Replica rank r pins to mesh lane r % L: its table lives on a
+    DIFFERENT device than the primary's lane 0, and answers are
+    unchanged (the replica falls back to the primary block set on a
+    shared filesystem)."""
+    monkeypatch.setenv("DOS_MESH_DEVICES", "4")
+    for rank in (1, 2):
+        eng = ShardEngine(toy_graph, dc1, 0, shard_dir, replica=rank)
+        assert set(eng.fm.devices()) == {jax.devices()[rank % 4]}
+        got = eng.answer(walk_queries, RuntimeConfig())[:3]
+        for a, b in zip(baseline[0], got):
+            np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------- mat collective
+
+@pytest.mark.parametrize("workers", LANES)
+def test_query_mat_parity(toy_graph, workers):
+    """The on-mesh collective mat row equals per-pair query answers at
+    every mesh size — duplicates and out-of-range targets included."""
+    dc = DistributionController("tpu", None, workers, toy_graph.n)
+    o = CPDOracle(toy_graph, dc,
+                  mesh=make_mesh(n_workers=workers)).build(chunk=16)
+    tg = np.concatenate([np.arange(0, toy_graph.n, 3), [7, 7]])
+    cost, fin = o.query_mat(5, tg)
+    pc, _pl, pf = o.query(
+        np.stack([np.full(len(tg), 5), tg], axis=1))
+    np.testing.assert_array_equal(cost, pc)
+    np.testing.assert_array_equal(fin, pf)
+    # out-of-range / negative targets come back unfinished, in place
+    cost2, fin2 = o.query_mat(5, [3, toy_graph.n + 9, -2, 8])
+    assert list(fin2) == [True, False, False, True]
+    # out-of-range source: whole row unanswered, no crash
+    cost3, fin3 = o.query_mat(toy_graph.n + 1, [3, 8])
+    assert not fin3.any()
+
+
+def test_query_mat_diffed(toy_graph):
+    dc = DistributionController("tpu", None, 4, toy_graph.n)
+    o = CPDOracle(toy_graph, dc,
+                  mesh=make_mesh(n_workers=4)).build(chunk=16)
+    w = toy_graph.weights_with_diff(synth_diff(toy_graph, frac=0.3,
+                                               seed=5))
+    tg = np.arange(0, toy_graph.n, 4)
+    cost, fin = o.query_mat(2, tg, w_query=w)
+    pc, _pl, pf = o.query(np.stack([np.full(len(tg), 2), tg], axis=1),
+                          w_query=w)
+    np.testing.assert_array_equal(cost, pc)
+    np.testing.assert_array_equal(fin, pf)
+
+
+def test_families_matrix_mesh_path(toy_graph, diff_file):
+    """QueryFamilies with an oracle answers ``mat`` via the collective
+    — the encoded MAT sentence matches the per-pair answers, free-flow
+    and under the frontend's diff, and no frontend submit happens."""
+    dc = DistributionController("tpu", None, 2, toy_graph.n)
+    o = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=2)).build(
+        chunk=16)
+
+    def _boom(*a, **k):                     # the fan-out path is dead
+        raise AssertionError("mesh mat must not submit futures")
+
+    frontend = types.SimpleNamespace(diff="-", submit=_boom)
+    fam = QueryFamilies(frontend, oracle=o)
+    tg = [3, 9, 14, 9]
+    res = fam.matrix(5, tg).result(timeout=1.0)
+    pc, _pl, pf = o.query(np.stack([np.full(len(tg), 5), tg], axis=1))
+    want = [int(c) if f else -1 for c, f in zip(pc, pf)]
+    assert res.costs == want
+    assert res.encode() == " ".join(
+        ["MAT", "5", str(len(tg))] + [str(c) for c in want])
+    # under a diff: weights re-read per diff change
+    frontend.diff = diff_file
+    res2 = fam.matrix(5, tg).result(timeout=1.0)
+    from distributed_oracle_search_tpu.data.formats import read_diff
+    w = toy_graph.weights_with_diff(read_diff(diff_file))
+    pc2, _pl2, pf2 = o.query(
+        np.stack([np.full(len(tg), 5), tg], axis=1), w_query=w)
+    assert res2.costs == [int(c) if f else -1
+                          for c, f in zip(pc2, pf2)]
+
+
+def test_query_mat_row_width_pads_pow2(toy_graph):
+    """The mat row's compiled width buckets at powers of two: k is
+    client-controlled, and an unpadded width would cache one XLA
+    program per distinct k forever."""
+    from distributed_oracle_search_tpu.parallel import sharded
+
+    dc = DistributionController("tpu", None, 2, toy_graph.n)
+    o = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=2)).build(
+        chunk=16)
+    o.query_mat(1, list(range(5)))
+    size0 = sharded._mat_fn.cache_info().currsize
+    for k in (5, 6, 7, 8):              # all in the width-8 bucket
+        cost, fin = o.query_mat(1, list(range(k)))
+        pc, _pl, pf = o.query(
+            np.stack([np.full(k, 1), np.arange(k)], axis=1))
+        np.testing.assert_array_equal(cost, pc)
+        np.testing.assert_array_equal(fin, pf)
+    assert sharded._mat_fn.cache_info().currsize == size0
+
+
+def test_query_mat_weight_buffer_cached_by_key(toy_graph):
+    """With a w_key, the padded device weights upload once per diff,
+    not once per row."""
+    dc = DistributionController("tpu", None, 2, toy_graph.n)
+    o = CPDOracle(toy_graph, dc, mesh=make_mesh(n_workers=2)).build(
+        chunk=16)
+    w = toy_graph.weights_with_diff(synth_diff(toy_graph, frac=0.2,
+                                               seed=9))
+    o.query_mat(1, [2, 4], w_query=w, w_key="d1")
+    buf = o._mat_weights["d1"]
+    o.query_mat(1, [3, 5, 6], w_query=w, w_key="d1")
+    assert o._mat_weights["d1"] is buf          # no re-upload
+    # keyless calls never populate the cache
+    o.query_mat(1, [2], w_query=w)
+    assert set(o._mat_weights) == {"d1"}
+
+
+def test_mesh_mat_oracle_refused_under_traffic(monkeypatch):
+    """DOS_MESH_MAT + --traffic-dir: the mesh oracle would serve
+    stale tables across epoch promotions, so serve wiring refuses it
+    (mat degrades to fan-out) instead of silently diverging."""
+    from distributed_oracle_search_tpu.cli.serve import _mesh_mat_oracle
+
+    monkeypatch.setenv("DOS_MESH_MAT", "1")
+    assert _mesh_mat_oracle(None, None, traffic=object()) is None
+    monkeypatch.setenv("DOS_MESH_MAT", "0")
+    assert _mesh_mat_oracle(None, None, traffic=None) is None
+
+
+# ----------------------------------------------- obs / gate satellites
+
+def test_bench_diff_mesh_directions():
+    """The mesh_* family's directions are explicit, pinned — and the
+    multichip smoke gates at tolerance 0 (any 1 -> 0 drop)."""
+    for key in ("mesh_build_rows_per_sec_d8",
+                "mesh_walk_queries_per_sec_d8",
+                "mesh_mat_rows_per_sec_d8",
+                "shard_strong_scaling_rows_per_sec_w1",
+                "shard_strong_scaling_rows_per_sec_w8",
+                "multichip_smoke_ok"):
+        assert fleet._KEY_DIRECTIONS[key] == "higher", key
+    assert fleet._KEY_DIRECTIONS[
+        "shard_strong_scaling_overhead_w8_seconds"] == "lower"
+    assert fleet._KEY_TOLERANCES["multichip_smoke_ok"] == 0.0
+
+
+def test_bench_diff_gates_mesh_regression(tmp_path):
+    """End-to-end through compare_bench: a mesh rate drop and a
+    multichip 1 -> 0 flip both gate; overhead seconds gate UPWARD."""
+    def _rec(name, headline):
+        p = tmp_path / name
+        p.write_text(json.dumps(
+            {"parsed": {"metric": "m", "value": 1.0,
+                        "headline": headline}}))
+        return str(p)
+
+    old = _rec("BENCH_r01.json", {"mesh_walk_queries_per_sec_d8": 1000,
+                                  "multichip_smoke_ok": 1,
+                                  "shard_strong_scaling_overhead_w8_seconds": 0.2})
+    new = _rec("BENCH_r02.json", {"mesh_walk_queries_per_sec_d8": 500,
+                                  "multichip_smoke_ok": 0,
+                                  "shard_strong_scaling_overhead_w8_seconds": 0.5})
+    out = fleet.compare_bench(old, new)
+    bad = {e["key"] for e in out["regressions"]}
+    assert bad == {"mesh_walk_queries_per_sec_d8",
+                   "multichip_smoke_ok",
+                   "shard_strong_scaling_overhead_w8_seconds"}
+
+
+def test_top_renders_mesh_column_blank_tolerantly():
+    """`dos-obs top` shows the lane count when a worker exports it and
+    blanks (not crashes) for older workers / odd types."""
+    newer = {"worker": {"mesh": {"devices": 4, "axis": "lane"}}}
+    older = {"worker": {"batches": 3}}
+    weird = {"worker": {"mesh": {"devices": None}}}
+    assert fleet._summarize(newer)["mesh"] == 4
+    assert "mesh" not in fleet._summarize(older)
+    assert "mesh" not in fleet._summarize(weird)
+    table = fleet.render_top({"a:1": newer, "b:2": older, "c:3": weird})
+    assert "mesh" in table.splitlines()[0]
+
+
+def test_metrics_registered_in_obs_map():
+    """New series documented in the obs/__init__ metric map (the
+    dos-lint metric-registry contract)."""
+    import distributed_oracle_search_tpu.obs as obs
+
+    for name in ("mesh_devices", "mesh_walk_batches_total",
+                 "mesh_collective_seconds"):
+        assert name in obs.__doc__, name
