@@ -21,6 +21,11 @@ grid + arterials; see ``data/synth.py``). Sections (env-gated):
   road       264k-node non-grid network: frontier build vs CPU Dijkstra,
              streamed/resident serving, free-flow AND congestion-diff
              rounds                                   (BENCH_ROAD=0 skips)
+  compressed RLE/pack4 compressed-RESIDENT shard on the road rows
+             (DOS_CPD_RESIDENT, models.resident): resident-bytes ratio,
+             decompress-at-use walk q/s vs the raw-resident walk, and
+             the per-batch decompress overhead — rides inside the road
+             section                            (BENCH_COMPRESSED=0 skips)
   weak       build-time scaling over a virtual 1/2/4/8-device CPU mesh
              (subprocess), decomposed into mesh wall-clock vs per-shard
              single-device time, plus shard strong scaling on the real
@@ -1266,6 +1271,7 @@ def main() -> None:
     # fallback on TPU vs per-source Dijkstra on CPU; serve streamed and
     # resident from the same index. BENCH_ROAD=0 skips.
     road_stats = {}
+    comp_stats = {}
     if os.environ.get("BENCH_ROAD", "1") != "0":
         import jax.numpy as jnp
 
@@ -1358,6 +1364,98 @@ def main() -> None:
             tpu_rps3 = trows / t_b3_s
             log(f"road TPU build ({kind3}): {trows} rows in "
                 f"{t_b3_s:.2f}s -> {tpu_rps3:,.1f} rows/s")
+
+            # ---- compressed residency (ROADMAP item 1): the SAME road
+            # rows resident raw vs RLE/pack4-compressed with
+            # decompress-at-use (models.resident, DOS_CPD_RESIDENT).
+            # The ratio is a codec property of THIS shard's bytes; the
+            # walk figures time the serving path's actual shape — the
+            # batch's distinct target rows inflate on device, then the
+            # same walk kernel runs — against the raw-resident walk on
+            # identical queries. BENCH_COMPRESSED=0 skips.
+            if os.environ.get("BENCH_COMPRESSED", "1") != "0":
+                from distributed_oracle_search_tpu.models.resident \
+                    import make_resident
+
+                ctab, ccodec = make_resident(fm64, codec="auto")
+                if ccodec == "raw":
+                    log("compressed: auto codec degraded to raw "
+                        "(incompressible shard); section skipped")
+                else:
+                    cratio = fm64.nbytes / ctab.nbytes
+                    log(f"compressed: {ccodec} residency "
+                        f"{fm64.nbytes / 2**20:.1f} MB -> "
+                        f"{ctab.nbytes / 2**20:.1f} MB "
+                        f"({cratio:.2f}x)")
+                    rngc = np.random.default_rng(9)
+                    cq = int(os.environ.get("BENCH_COMPRESSED_QUERIES",
+                                            20_000))
+                    qsc = rngc.integers(0, g3.n, cq)
+                    qtc = rngc.integers(0, trows, cq)
+                    estc = (np.abs(g3.xs[qsc] - g3.xs[qtc])
+                            + np.abs(g3.ys[qsc] - g3.ys[qtc]))
+                    oc = np.argsort(estc, kind="stable")
+                    qpc = 1 << (cq - 1).bit_length()
+                    rrc = np.zeros(qpc, np.int32)
+                    ssc = np.zeros(qpc, np.int32)
+                    ttc = np.zeros(qpc, np.int32)
+                    vvc = np.zeros(qpc, bool)
+                    rrc[:cq] = qtc[oc]
+                    ssc[:cq] = qsc[oc]
+                    ttc[:cq] = qtc[oc]
+                    vvc[:cq] = True
+                    fmcr = jnp.asarray(fm64)
+                    (ccr, _pcr, _fcr), t_craw = best_of(
+                        lambda: jax.block_until_ready(table_search_batch(
+                            dg3, fmcr, rrc, ssc, ttc, dg3.w_pad,
+                            valid=vvc)))
+                    # the engine's decompress-at-use shape: distinct
+                    # rows inflate once, row ids remap onto the dense
+                    # block, the walk is unchanged
+                    urc, rinvc = np.unique(rrc, return_inverse=True)
+                    rpadc = 1 << (len(urc) - 1).bit_length()
+                    ruc = np.zeros(rpadc, np.int32)
+                    ruc[:len(urc)] = urc
+                    ruc_d = jnp.asarray(ruc)
+                    rrc2 = rinvc.reshape(-1).astype(np.int32)
+
+                    def comp_walk():
+                        fmw = ctab.decompress_rows(ruc_d)
+                        return jax.block_until_ready(table_search_batch(
+                            dg3, fmw, rrc2, ssc, ttc, dg3.w_pad,
+                            valid=vvc))
+
+                    (ccc, _pcc, _fcc), t_ccmp = best_of(comp_walk)
+                    assert (np.asarray(ccc) == np.asarray(ccr)).all(), \
+                        "compressed-resident walk != raw-resident walk"
+                    _, t_cdec = best_of(
+                        lambda: jax.block_until_ready(
+                            ctab.decompress_rows(ruc_d)))
+                    cqps_raw = cq / t_craw.interval
+                    cqps_cmp = cq / t_ccmp.interval
+                    log(f"compressed walk: raw {cqps_raw:,.0f} q/s vs "
+                        f"{ccodec} {cqps_cmp:,.0f} q/s "
+                        f"({cqps_cmp / cqps_raw:.2f}x; decompress "
+                        f"{t_cdec.interval * 1e3:.1f} ms/batch for "
+                        f"{len(urc)} distinct rows)")
+                    comp_stats = {
+                        "compressed_codec": ccodec,
+                        "compressed_rows": trows,
+                        "compressed_raw_mb": round(
+                            fm64.nbytes / 2**20, 1),
+                        "compressed_resident_mb": round(
+                            ctab.nbytes / 2**20, 1),
+                        "cpd_resident_bytes_ratio": round(cratio, 2),
+                        "compressed_raw_walk_queries_per_sec": round(
+                            cqps_raw, 1),
+                        "compressed_walk_queries_per_sec": round(
+                            cqps_cmp, 1),
+                        "compressed_vs_raw_walk_ratio": round(
+                            cqps_cmp / cqps_raw, 3),
+                        "compressed_decompress_seconds": round(
+                            t_cdec.interval, 4),
+                    }
+                    del fmcr, ctab
 
             bins = (_native_bins()
                     if os.environ.get("BENCH_CPU", "1") != "0" else None)
@@ -2484,6 +2582,7 @@ def main() -> None:
         },
         **scale_stats,
         **road_stats,
+        **comp_stats,
         **delta_stats,
         **weak_stats,
         **mesh_stats,
@@ -2533,6 +2632,8 @@ def main() -> None:
         "road_build_parity_cores", "road_tpu_build_rows_per_sec",
         "road_stream_queries_per_sec", "road_resident_queries_per_sec",
         "road_tpu_resident_speedup", "road_multidiff_fused_speedup",
+        "cpd_resident_bytes_ratio", "compressed_walk_queries_per_sec",
+        "compressed_vs_raw_walk_ratio",
         "build_delta_vs_full_ratio", "build_delta_rows_per_sec",
         "shard_strong_scaling_rows_per_sec",
         "shard_strong_scaling_rows_per_sec_w1",

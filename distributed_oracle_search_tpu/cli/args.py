@@ -191,6 +191,13 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                      default="python",
                      help="Host-mode worker engine: the JAX shard engine "
                           "or the native C++ binaries (./install.sh).")
+    new.add_argument("--codec", choices=["raw", "pack4", "rle", "auto"],
+                     default=None,
+                     help="make_cpds: persist CPD blocks compressed "
+                          "(models.resident RLE/pack4 containers; "
+                          "per-block degrade to raw when not viable). "
+                          "Default: the DOS_CPD_RESIDENT knob, whose "
+                          "raw default keeps the legacy block format.")
 
     obs = p.add_argument_group("observability")
     obs.add_argument("--trace", type=str, default="",

@@ -42,7 +42,8 @@ log = get_logger(__name__)
 
 def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0,
                      engine: str = "python",
-                     resume: bool = True) -> str:
+                     resume: bool = True,
+                     codec: str | None = None) -> str:
     """The shell command a host-mode worker runs (our ``make_cpd_auto``)."""
     partkey = (" ".join(str(b) for b in conf.partkey)
                if isinstance(conf.partkey, (list, tuple))
@@ -52,6 +53,9 @@ def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0,
         if chunk:
             log.warning("--chunk is a JAX-builder staging knob; the native "
                         "builder works block-by-block and ignores it")
+        if codec:
+            log.warning("--codec is a JAX-builder knob; the native "
+                        "builder writes raw blocks and ignores it")
         return (f"{require_binary('make_cpd_auto')}"
                 f" --input {conf.xy_file} --partmethod {conf.partmethod}"
                 f" --partkey {partkey} --workerid {wid}"
@@ -64,6 +68,8 @@ def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0,
         cmd += f" --chunk {chunk}"
     if not resume:
         cmd += " --no-resume"
+    if codec:
+        cmd += f" --codec {codec}"
     repl = conf.effective_replication()
     if repl > 1:
         cmd += f" --replication {repl}"
@@ -71,13 +77,15 @@ def worker_build_cmd(wid: int, conf: ClusterConfig, chunk: int = 0,
 
 
 def call_worker(wid: int, conf: ClusterConfig, chunk: int = 0,
-                engine: str = "python", resume: bool = True):
+                engine: str = "python", resume: bool = True,
+                codec: str | None = None):
     """Launch one worker's build (parity: reference ``make_cpds.py:10-25``).
 
     Returns a Popen handle when the build runs as a tracked local
     subprocess, else None (tmux/ssh detached)."""
     host = conf.workers[wid]
-    cmd = worker_build_cmd(wid, conf, chunk, engine, resume=resume)
+    cmd = worker_build_cmd(wid, conf, chunk, engine, resume=resume,
+                           codec=codec)
     log.info("launch build w%d on %s: %s", wid, host, cmd)
     # prefer_track: builds are finite jobs — await local ones so the index
     # manifest can be finalized when they all complete
@@ -201,7 +209,7 @@ def run_tpu(conf: ClusterConfig, args) -> None:
     mesh = mesh_from_config(conf)
     oracle = CPDOracle(graph, dc, mesh=mesh)
     oracle.build(chunk=args.chunk)
-    oracle.save(conf.outdir)
+    oracle.save(conf.outdir, codec=getattr(args, "codec", None))
     print(f"built sharded CPD for {graph.n} nodes over "
           f"{conf.maxworker} mesh shards -> {conf.outdir}")
 
@@ -216,7 +224,8 @@ def run_host(conf: ClusterConfig, args) -> None:
         if args.worker != -1 and wid != args.worker:
             continue
         proc = call_worker(wid, conf, chunk=args.chunk, engine=args.engine,
-                           resume=resume)
+                           resume=resume,
+                           codec=getattr(args, "codec", None))
         if proc is not None:
             procs.append((wid, proc))
     failures = 0

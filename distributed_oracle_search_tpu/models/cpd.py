@@ -62,6 +62,10 @@ from ..utils.atomicio import (
 )
 from ..utils.env import env_cast, env_flag
 from ..utils.log import get_logger
+from .resident import (
+    block_codec, encode_block, is_container, maybe_decode_rows,
+    resident_choice,
+)
 
 log = get_logger(__name__)
 
@@ -307,17 +311,22 @@ class BuildLedger:
         return out
 
     def record(self, fname: str, digest: str, shape, dtype: str,
-               epoch: int | None = None) -> None:
+               epoch: int | None = None,
+               codec: str | None = None) -> None:
         """Journal one completed block. ``epoch`` keys the line to a
         diff-epoch build (delta rebuilds and their full-degrade path):
         readers that resume an epoch-keyed build treat entries from any
         OTHER epoch as invalid — epoch-keyed block invalidation — while
         legacy readers simply ignore the unknown key (the codec
-        contract)."""
+        contract). ``codec`` records a compressed block's encoding
+        (``models.resident``) so the manifest harvest can carry it;
+        raw blocks omit the key, keeping legacy ledgers byte-identical."""
         ent = {"file": fname, "digest": digest,
                "shape": list(shape), "dtype": dtype}
         if epoch is not None:
             ent["epoch"] = int(epoch)
+        if codec is not None:
+            ent["codec"] = str(codec)
         line = json.dumps(ent)
         with open(self.path, "a") as f:
             f.write(line + "\n")
@@ -639,7 +648,8 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
                        resume: bool = True,
                        method: str = "auto", replica: int = 0,
                        epoch: int | None = None,
-                       ctx: dict | None = None) -> list[str]:
+                       ctx: dict | None = None,
+                       codec: str | None = None) -> list[str]:
     """Build and persist ONE worker's CPD block files on the local device.
 
     This is the host-mode build unit: the reference launches one
@@ -689,6 +699,13 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
     ``_delta_compute_ctx``: a resident worker (or a bench timing the
     build) rebuilding repeatedly must not pay a CSR re-upload and
     kernel re-pick per call.
+
+    ``codec``: persist blocks compressed (``models.resident``
+    RLE/pack4 containers; None resolves ``DOS_CPD_RESIDENT``, whose
+    ``raw`` default keeps the legacy byte-identical .npy rows). Each
+    block encodes independently and degrades to raw when its rows are
+    not viable; the ledger line and the manifest harvest record the
+    codec that actually applied.
 
     With ``DOS_MESH_DEVICES`` > 1 the per-chunk kernel calls run
     lane-parallel on the worker's local mesh (per-device target lanes
@@ -793,6 +810,8 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         M_STAGE_OVERLAP.observe(time.perf_counter() - t0)
         return (bid, fname, lens, pads, writer)
 
+    codec_req = resident_choice() if codec is None else codec
+
     def flush(entry) -> None:
         bid, fname, lens, devs, writer = entry
         # RLE-compressed fetch per chunk (plain for small blocks): the
@@ -809,13 +828,22 @@ def build_worker_shard(graph: Graph, dc: DistributionController, wid: int,
         trimmed = [p[:ln] for p, ln in zip(parts, lens)]
         arr = (trimmed[0] if len(trimmed) == 1
                else np.concatenate(trimmed))
+        # compressed persistence (DOS_CPD_RESIDENT / the codec param):
+        # the block lands as a self-describing container through the
+        # SAME atomic writer — digest, ledger, heal, and replica copies
+        # all operate on the container bytes
+        enc = encode_block(arr, codec_req)
+        if enc is not None:
+            arr, blk_codec = enc
+        else:
+            blk_codec = None
         # atomic write (into the pre-opened tmp), then the ledger line:
         # a kill between the two leaves a complete un-journaled file
         # (the legacy-parse resume path accepts it); a kill MID-write
         # leaves only tmp debris
         digest = writer.commit(arr)
         ledger.record(fname, digest, arr.shape, str(arr.dtype),
-                      epoch=epoch)
+                      epoch=epoch, codec=blk_codec)
         # chaos hook: DOS_FAULTS="crash-build;..." dies here, between
         # block flushes — the kill-mid-build resume test's trigger
         rule = faults.inject("crash-build", wid=wid)
@@ -1015,10 +1043,17 @@ def delta_build_worker_shard(graph_new: Graph, dc: DistributionController,
     max_frac = env_cast("DOS_BUILD_DELTA_MAX_FRAC", 0.75, float)
     if dirty is None or (len(owned)
                          and dirty_owned.mean() > max_frac):
+        # the degraded full rebuild keeps the old index's block codec
+        # (first recorded one — indexes are built under one knob), so
+        # a compressed index's delta chain stays compressed even when
+        # the splice does not pay
+        codec_hint = next(
+            (m.get("codec") for m in (old_blocks_meta or {}).values()
+             if isinstance(m, dict) and m.get("codec")), "raw")
         written = build_worker_shard(graph_new, dc, wid, outdir,
                                      chunk=chunk, max_iters=max_iters,
                                      resume=resume, method=method,
-                                     epoch=epoch)
+                                     epoch=epoch, codec=codec_hint)
         report["degraded_full"] = True
         report["rows_recomputed"] = int(
             min(len(written) * bs, len(owned)))
@@ -1088,6 +1123,10 @@ def delta_build_worker_shard(graph_new: Graph, dc: DistributionController,
     for bid, fname, blk, bmask, old_ok in todo:
         old_path = os.path.join(old_outdir, fname)
         old_meta = old_blocks_meta.get(fname)
+        # spliced/recomputed blocks keep the OLD block's codec — a
+        # compressed index's delta chain stays compressed (byte copies
+        # carry the container verbatim anyway)
+        out_codec = (old_meta or {}).get("codec")
         if bmask is None:
             # clean block: byte copy, digest cross-checked against the
             # old manifest — a MISSING source (quarantined, swept) or a
@@ -1115,7 +1154,8 @@ def delta_build_worker_shard(graph_new: Graph, dc: DistributionController,
                 arr = np.load(os.path.join(outdir, fname),
                               mmap_mode="r")
                 ledger.record(fname, digest, arr.shape,
-                              str(arr.dtype), epoch=epoch)
+                              str(arr.dtype), epoch=epoch,
+                              codec=out_codec)
                 report["blocks_skipped"] += 1
                 M_DELTA_SKIPPED.inc()
                 crash_point()
@@ -1131,6 +1171,13 @@ def delta_build_worker_shard(graph_new: Graph, dc: DistributionController,
                 # kept only the verify status) — bounded host memory
                 rows_old, status, reason = load_verified_block(
                     old_path, old_meta)
+                if rows_old is not None:
+                    try:
+                        # compressed old blocks inflate for the splice
+                        rows_old = maybe_decode_rows(rows_old)
+                    except ValueError as e:
+                        rows_old, status, reason = (
+                            None, "corrupt", f"undecodable: {e}")
                 if rows_old is None:
                     # vanished/torn between passes (rare race): the
                     # batched fresh rows only cover bmask, so the
@@ -1146,9 +1193,14 @@ def delta_build_worker_shard(graph_new: Graph, dc: DistributionController,
                 else:
                     arr = np.asarray(rows_old).copy()
                     arr[bmask] = fresh
+        enc = encode_block(arr, out_codec)
+        if enc is not None:
+            arr, out_codec = enc
+        else:
+            out_codec = None
         digest = atomic_save_npy(os.path.join(outdir, fname), arr)
         ledger.record(fname, digest, arr.shape, str(arr.dtype),
-                      epoch=epoch)
+                      epoch=epoch, codec=out_codec)
         report["rows_recomputed"] += n_new
         M_DELTA_ROWS.inc(n_new)
         crash_point()
@@ -1270,6 +1322,25 @@ def delta_build_index(graph: Graph, dc: DistributionController,
     return report
 
 
+def _primary_codec(outdir: str, shard: int) -> str:
+    """The codec shard ``shard``'s PRIMARY blocks were written with
+    (ledger first, block sniff second, raw default) — what a replica
+    RECOMPUTE must use so its digest can ever match the primary's in
+    the anti-entropy cross-check."""
+    for ent in BuildLedger(outdir, shard).entries().values():
+        if ent.get("codec"):
+            return str(ent["codec"])
+    try:
+        arr = np.load(os.path.join(outdir, shard_block_name(shard, 0)),
+                      mmap_mode="r")
+        if is_container(arr):
+            return str(block_codec(arr))
+    except (OSError, ValueError) as e:
+        log.debug("primary codec sniff for shard %d failed (%s); "
+                  "assuming raw", shard, e)
+    return "raw"
+
+
 def copy_replica_blocks(dc: DistributionController, shard: int,
                         replica: int, outdir: str,
                         resume: bool = True) -> list[str]:
@@ -1302,9 +1373,13 @@ def copy_replica_blocks(dc: DistributionController, shard: int,
             want_rows=True)
         if rows is None:
             continue        # no healthy primary: caller recomputes
+        # a compressed primary copies verbatim — the replica ships
+        # (and stores) the compressed container bytes
         digest = atomic_save_npy(os.path.join(outdir, fname),
                                  np.asarray(rows))
-        ledger.record(fname, digest, rows.shape, str(rows.dtype))
+        ledger.record(fname, digest, rows.shape, str(rows.dtype),
+                      codec=(block_codec(np.asarray(rows))
+                             if is_container(rows) else None))
         M_REPLICA_COPIED.inc()
         written.append(fname)
     return written
@@ -1323,9 +1398,14 @@ def build_replica_shards(graph: Graph, dc: DistributionController,
     for r in range(1, dc.replication):
         shard = (host_wid - r) % dc.maxworker
         copied = copy_replica_blocks(dc, shard, r, outdir, resume=resume)
+        # recomputed replica blocks keep the PRIMARY's codec — a raw
+        # recompute of a compressed primary would fail the anti-entropy
+        # digest cross-check forever (quarantine/rebuild loop)
         computed = build_worker_shard(graph, dc, shard, outdir,
                                       chunk=chunk, resume=True,
-                                      method=method, replica=r)
+                                      method=method, replica=r,
+                                      codec=_primary_codec(outdir,
+                                                           shard))
         out[shard] = sorted(set(copied) | set(computed))
         if copied or computed:
             log.info("worker %d: replica r%d of shard %d ready "
@@ -1346,12 +1426,20 @@ def _block_meta_for(outdir: str, fname: str,
         ledgers[key] = BuildLedger(outdir, wid, replica).entries()
     ent = ledgers[key].get(fname)
     if ent is not None and "digest" in ent:
-        return {"digest": ent["digest"], "shape": list(ent["shape"]),
+        meta = {"digest": ent["digest"], "shape": list(ent["shape"]),
                 "dtype": ent["dtype"]}
+        if ent.get("codec"):
+            meta["codec"] = ent["codec"]
+        return meta
     path = os.path.join(outdir, fname)
     arr = np.load(path, mmap_mode="r")
-    return {"digest": digest_file(path), "shape": list(arr.shape),
+    meta = {"digest": digest_file(path), "shape": list(arr.shape),
             "dtype": str(arr.dtype)}
+    # compressed containers are self-describing — an un-ledgered one
+    # still gets its codec into the manifest
+    if is_container(arr):
+        meta["codec"] = block_codec(np.asarray(arr))
+    return meta
 
 
 def write_index_manifest(outdir: str, dc: DistributionController,
@@ -1510,6 +1598,17 @@ def _verify_block(path: str, meta: dict | None, want_rows: bool):
             if "dtype" in meta and str(arr.dtype) != meta["dtype"]:
                 return None, "corrupt", (f"dtype {arr.dtype} != "
                                          f"manifest {meta['dtype']}")
+            if meta.get("codec"):
+                # compressed block: the container header must parse
+                # and name the manifest's codec — a payload that
+                # digests clean but decodes to the wrong codec (or to
+                # garbage) is corrupt, not servable
+                got_codec = (block_codec(np.asarray(arr))
+                             if is_container(arr) else None)
+                if got_codec != meta["codec"]:
+                    return None, "corrupt", (
+                        f"codec {got_codec!r} != manifest "
+                        f"{meta['codec']!r}")
     except Exception as e:  # noqa: BLE001 — torn header, short file, ...
         return None, "corrupt", f"unreadable: {type(e).__name__}: {e}"
     return (arr if want_rows else None,
@@ -1546,6 +1645,7 @@ def heal_block(outdir: str, manifest: dict | None, fname: str, wid: int,
     path = os.path.join(outdir, fname)
     qpath = quarantine(path)
     replica = block_file_replica(fname)
+    meta = (manifest or {}).get("blocks", {}).get(fname)
     log.warning("CPD block %s is %s (%s); %srebuilding from the graph",
                 fname, status, reason,
                 f"quarantined to {qpath}; " if qpath else "")
@@ -1555,14 +1655,17 @@ def heal_block(outdir: str, manifest: dict | None, fname: str, wid: int,
             # a replica heals from its primary when one is on disk
             # (digest-valid copy), recomputing only as a fallback
             copy_replica_blocks(dc, wid, replica, outdir)
-        build_worker_shard(graph, dc, wid, outdir, replica=replica)
+        # the rebuild keeps the block's recorded codec so a healed
+        # compressed index stays compressed (and vice versa) — the
+        # manifest, not the process env, owns the block's format
+        build_worker_shard(graph, dc, wid, outdir, replica=replica,
+                           codec=(meta or {}).get("codec", "raw"))
     rows, _status2, reason2 = load_verified_block(path, None)
     if rows is None:
         raise ValueError(
             f"CPD block {fname} in {outdir} could not be rebuilt: "
             f"{reason2} (original fault: {reason})")
     M_BLOCKS_REBUILT.inc()
-    meta = (manifest or {}).get("blocks", {}).get(fname)
     new_digest = digest_file(path)
     if meta is not None and meta.get("digest") != new_digest:
         if meta.get("digest"):
@@ -1570,11 +1673,14 @@ def heal_block(outdir: str, manifest: dict | None, fname: str, wid: int,
                 "rebuilt %s has digest %s != manifest %s (different "
                 "build kernel?); refreshing the manifest entry",
                 fname, new_digest, meta["digest"])
-        manifest["blocks"][fname] = {"digest": new_digest,
-                                     "shape": list(rows.shape),
-                                     "dtype": str(rows.dtype)}
+        new_meta = {"digest": new_digest, "shape": list(rows.shape),
+                    "dtype": str(rows.dtype)}
+        if is_container(rows):
+            new_meta["codec"] = block_codec(np.asarray(rows))
+        manifest["blocks"][fname] = new_meta
         atomic_write_json(os.path.join(outdir, "index.json"), manifest)
-    return rows
+    # callers serve rows, not containers
+    return maybe_decode_rows(rows)
 
 
 def read_manifest(outdir: str) -> dict:
@@ -1698,8 +1804,14 @@ def anti_entropy(outdir: str, dc: DistributionController,
                     quarantine(rpath)
                     copied = copy_replica_blocks(dc, shard, r, outdir)
                     if rname not in copied and graph is not None:
-                        build_worker_shard(graph, dc, shard, outdir,
-                                           replica=r)
+                        # recompute with the primary's codec (see
+                        # build_replica_shards) so the healed digest
+                        # can converge with the cross-check
+                        build_worker_shard(
+                            graph, dc, shard, outdir, replica=r,
+                            codec=(prim_meta or {}).get(
+                                "codec", _primary_codec(outdir,
+                                                        shard)))
                 rows, status, reason = load_verified_block(rpath, None)
                 if rows is None:
                     log.error("anti-entropy could not heal %s: %s "
@@ -1710,9 +1822,13 @@ def anti_entropy(outdir: str, dc: DistributionController,
                 if (manifest is not None
                         and blocks_meta.get(rname, {}).get("digest")
                         != new_digest):
-                    blocks_meta[rname] = {"digest": new_digest,
-                                          "shape": list(rows.shape),
-                                          "dtype": str(rows.dtype)}
+                    new_meta = {"digest": new_digest,
+                                "shape": list(rows.shape),
+                                "dtype": str(rows.dtype)}
+                    if is_container(rows):
+                        new_meta["codec"] = block_codec(
+                            np.asarray(rows))
+                    blocks_meta[rname] = new_meta
                     manifest_dirty = True
     if manifest_dirty:
         # one atomic manifest rewrite for the whole pass, not one per
@@ -1842,8 +1958,14 @@ class CPDOracle:
         return self
 
     # ------------------------------------------------------- persistence
-    def save(self, outdir: str) -> None:
+    def save(self, outdir: str, codec: str | None = None) -> None:
         """Write the CPD index: one .npy per (worker, block) + manifest.
+
+        ``codec``: persist blocks compressed (``models.resident``
+        containers; None resolves ``DOS_CPD_RESIDENT`` — the ``raw``
+        default keeps the legacy byte-identical layout). Per-block
+        degrade to raw when not viable; the manifest's ``blocks``
+        entries record the codec that applied (unknown-key tolerant).
 
         Multi-controller safe: with >1 JAX process each WORKER's slice
         is allgathered separately (its shards live on non-addressable
@@ -1853,6 +1975,7 @@ class CPDOracle:
         index directory."""
         if self.fm is None:
             raise RuntimeError("build() or load() before save()")
+        codec_req = resident_choice() if codec is None else codec
         multi = jax.process_count() > 1
         if multi:
             from ..parallel.multihost import is_primary
@@ -1875,11 +1998,17 @@ class CPDOracle:
                     fname = shard_block_name(wid, b0 // bs)
                     arr = np.ascontiguousarray(
                         rows_w[b0:min(b0 + bs, n_owned)])
+                    enc = encode_block(arr, codec_req)
+                    blk_codec = None
+                    if enc is not None:
+                        arr, blk_codec = enc
                     digest = atomic_save_npy(
                         os.path.join(outdir, fname), arr)
                     block_meta[fname] = {"digest": digest,
                                          "shape": list(arr.shape),
                                          "dtype": str(arr.dtype)}
+                    if blk_codec is not None:
+                        block_meta[fname]["codec"] = blk_codec
             del rows_w
         if primary:
             write_index_manifest(
@@ -1930,6 +2059,10 @@ class CPDOracle:
                 # only digest-checked blocks count as verified; v1
                 # (digest-less) blocks load fine but stay unverified
                 M_BLOCKS_VERIFIED.inc()
+            # compressed containers inflate here: the mesh oracle is
+            # raw-resident (its [W, R, N] tensor shards over workers);
+            # compressed RESIDENCY is the ShardEngine's serving path
+            rows = maybe_decode_rows(rows)
             fm[wid, bid * bs: bid * bs + len(rows)] = rows
         self.fm = jax.device_put(fm, worker_sharding(self.mesh, rank=3))
         return self

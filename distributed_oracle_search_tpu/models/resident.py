@@ -1,0 +1,510 @@
+"""Compressed-resident CPD shards: RLE/pack4 rows in device memory.
+
+The paper's worker answers s–t queries by first-move lookups into a
+*resident* CPD shard, so per-worker graph scale is capped by how many
+raw ``[R, N]`` int8 rows fit in device memory — and R-way replication
+(PR 5) multiplies that cost R×. The stream path already proved the
+compression ratio on this exact data (``models.streamed``: 1.6 GB raw →
+~31 MB wire via RLE/pack4 sidecars); this module makes the RESIDENT
+representation compressed and decompresses only at the point of use
+(ROADMAP item 1, the last numbered perf item).
+
+Two codecs, selected by ``DOS_CPD_RESIDENT`` (via ``utils.env``;
+default ``raw`` = byte-identical legacy behavior):
+
+``pack4``
+    Two first-move slots per byte: slots 0..13 pack directly into a
+    nibble, 0xF is the ``-1`` "no move" marker (the wire format's
+    nibble vocabulary, ``models.streamed`` ``PACK4_ESCAPE``/
+    ``PACK4_MARKER``). Unlike the wire format there is NO escape list —
+    a resident row must be addressable without a scatter pass — so the
+    codec applies only when every entry is < 14 (max out-degree ≤ 14,
+    which covers road networks; a hub-heavy graph degrades to ``rle``
+    or ``raw``). Fixed 2× ratio, trivially row-addressable: the Pallas
+    walk kernel stages the PACKED row through its double-buffered DMA
+    tile and unpacks on-chip (``ops.pallas_walk`` ``packed4``) — raw
+    rows never exist in HBM at all.
+
+``rle``
+    Run-length over the TARGET axis — the same coherence the wire
+    format exploits (nearby target rows are reached the same way from
+    almost every source; measured mean column-run length 14-34 on road
+    chunks). Rows are split into **row groups** of ``group`` rows
+    (``DOS_CPD_RLE_GROUP``, default 4096): within a group, each source
+    column's runs break at the column and group boundaries, so a run
+    start fits uint16 and every run is addressable through the
+    per-(group, column) **offsets index** — ``offsets[g * N + s]``
+    bounds the run range of one cell, which is what makes an arbitrary
+    bucket's rows addressable without decoding the whole shard. Layout
+    (flat, no per-cell padding): ``vals`` int8 [T] run first-moves,
+    ``starts`` uint16 [T] in-group start rows, ``offsets`` int32
+    [n_groups * N + 1] — ~3 bytes per run, measured 4-8× over raw on
+    road-shaped tables. Decompression is a bounded binary search per
+    (row, source) over the cell's runs (``log2(max cell runs)`` static
+    steps) — the "gather over run-starts via searchsorted" XLA path
+    that serves BOTH walk kernels, the mesh lanes, and the
+    chunked-deadline path.
+
+``auto``
+    The smaller viable codec (ties prefer ``rle``); neither viable —
+    an incompressible table — degrades to ``raw`` with
+    ``cpd_resident_degraded_total`` booked, never a fault.
+
+The same encodings persist on disk: :func:`encode_block` wraps a
+block's encoded arrays in a self-describing uint8 container written
+through the ordinary atomic ``.npy`` machinery, so digests, ledgers,
+quarantine/heal, replica copies, and adopter catch-up all work
+unchanged — and a catch-up/anti-entropy copy of a compressed block
+ships the compressed bytes. Manifest v2 ``blocks{...}`` entries gain a
+``codec`` field (unknown-key tolerant, gate-only-on-NEWER per the wire
+contract); the container itself is self-describing, so a manifest-less
+partial index still decodes.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..utils.env import env_cast, env_str
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+#: accepted DOS_CPD_RESIDENT spellings; anything else degrades to raw
+RESIDENT_CODECS = ("raw", "pack4", "rle", "auto")
+
+#: nibble vocabulary shared with the streamed wire format
+#: (``models.streamed.PACK4_ESCAPE``/``PACK4_MARKER`` — duplicated here
+#: because streamed imports models.cpd which imports this module):
+#: slots 0..13 pack directly, 15 is the -1 marker. The resident codec
+#: has no 0xE escape list — it requires every slot < 14 instead.
+PACK4_ESCAPE = 14
+PACK4_MARKER = 15
+
+#: rle is viable only when it actually wins: resident bytes must come
+#: in under this fraction of the raw table (the wire format's
+#: break-even discipline, ``models.streamed.RLE_MAX_FRAC``)
+RESIDENT_RLE_MAX_FRAC = 0.9
+
+#: default rows per rle row group; run starts are uint16 so the group
+#: is capped at 65536 rows, and smaller groups mean shorter cell
+#: searches at slightly more run breakage
+_RLE_GROUP_DEFAULT = 4096
+
+M_RESIDENT_BYTES = obs_metrics.gauge(
+    "cpd_resident_bytes",
+    "device bytes of the most recently materialized resident first-move"
+    " table after codec selection (raw bytes when the codec degraded)")
+M_RESIDENT_DEGRADED = obs_metrics.counter(
+    "cpd_resident_degraded_total",
+    "resident tables whose requested DOS_CPD_RESIDENT codec was not "
+    "viable (escape slots for pack4, incompressible runs for rle) and "
+    "were served raw instead — a degrade, never a fault")
+M_DECOMPRESS = obs_metrics.histogram(
+    "cpd_decompress_seconds",
+    "per-batch decompress-at-use of a compressed-resident shard's "
+    "target rows (pack4 nibble unpack / rle run-start search) before "
+    "the walk kernel runs")
+
+
+def resident_choice() -> str:
+    """The raw ``DOS_CPD_RESIDENT`` knob: ``raw`` / ``pack4`` / ``rle``
+    / ``auto``; malformed values degrade to ``raw`` with a log line
+    (the shared ``utils.env`` policy)."""
+    raw = (env_str("DOS_CPD_RESIDENT", "raw") or "raw").strip().lower()
+    if raw not in RESIDENT_CODECS:
+        log.warning("ignoring malformed DOS_CPD_RESIDENT=%r (using "
+                    "'raw'; valid: %s)", raw, "/".join(RESIDENT_CODECS))
+        return "raw"
+    return raw
+
+
+def rle_group_rows() -> int:
+    """``DOS_CPD_RLE_GROUP``: rows per rle row group, clamped to
+    [2, 65536] (run starts are uint16)."""
+    g = env_cast("DOS_CPD_RLE_GROUP", _RLE_GROUP_DEFAULT, int)
+    if g < 2 or g > 65536:
+        log.warning("DOS_CPD_RLE_GROUP=%d out of [2, 65536]; using %d",
+                    g, _RLE_GROUP_DEFAULT)
+        g = _RLE_GROUP_DEFAULT
+    return g
+
+
+# -------------------------------------------------------------- encoders
+
+def encode_pack4(fm: np.ndarray) -> np.ndarray | None:
+    """[R, N] int8 fm -> [R, ceil(N/2)] uint8 nibble pairs, or None
+    when any entry >= 14 (the wire format escapes those; the resident
+    codec refuses instead — rows must decode without a scatter)."""
+    fm = np.asarray(fm, np.int8)
+    if fm.ndim != 2 or fm.size == 0:
+        return None
+    if int(fm.max(initial=-1)) >= PACK4_ESCAPE:
+        return None
+    a = np.where(fm < 0, np.uint8(PACK4_MARKER), fm.astype(np.uint8))
+    if a.shape[1] % 2:
+        a = np.concatenate(
+            [a, np.full((a.shape[0], 1), np.uint8(PACK4_MARKER))],
+            axis=1)
+    return np.ascontiguousarray(a[:, 0::2] | (a[:, 1::2] << 4))
+
+
+def encode_rle(fm: np.ndarray, group: int | None = None):
+    """[R, N] int8 fm -> ``(starts u16 [T], vals i8 [T],
+    offsets i32 [n_groups * N + 1], group)`` in (group, column)-major
+    run order, or None when the encoding would not beat
+    ``RESIDENT_RLE_MAX_FRAC`` of the raw bytes (incompressible table —
+    the caller degrades)."""
+    fm = np.asarray(fm, np.int8)
+    if fm.ndim != 2 or fm.shape[0] < 2 or fm.shape[1] == 0:
+        return None
+    r, n = fm.shape
+    group = rle_group_rows() if group is None else int(group)
+    group = min(group, 65536)
+    n_groups = -(-r // group)
+    # cheap reject BEFORE the per-group transposes (same trick as the
+    # wire encoder): the row-to-row change count bounds the run count
+    # from below, so an over-budget table pays one compare pass
+    runs_min = int(np.count_nonzero(fm[1:] != fm[:-1])) + n
+    if 3 * runs_min >= RESIDENT_RLE_MAX_FRAC * fm.nbytes:
+        return None
+    starts_l, vals_l, counts_l = [], [], []
+    for gi in range(n_groups):
+        a = np.ascontiguousarray(fm[gi * group:(gi + 1) * group].T)
+        gg = a.shape[1]                                     # [N, gg]
+        ch = np.empty((n, gg), bool)
+        ch[:, 0] = True
+        ch[:, 1:] = a[:, 1:] != a[:, :-1]
+        idx = np.flatnonzero(ch.reshape(-1))
+        starts_l.append((idx % gg).astype(np.uint16))
+        vals_l.append(a.reshape(-1)[idx])
+        counts_l.append(np.bincount(idx // gg,
+                                    minlength=n).astype(np.int64))
+    starts = np.concatenate(starts_l)
+    vals = np.concatenate(vals_l)
+    offsets64 = np.zeros(n_groups * n + 1, np.int64)
+    np.cumsum(np.concatenate(counts_l), out=offsets64[1:])
+    if offsets64[-1] >= 2**31:
+        return None                       # int32 offsets would wrap
+    offsets = offsets64.astype(np.int32)
+    nbytes = starts.nbytes + vals.nbytes + offsets.nbytes
+    if nbytes >= RESIDENT_RLE_MAX_FRAC * fm.nbytes:
+        return None
+    return starts, vals, offsets, group
+
+
+# ------------------------------------------------------ device decoders
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _decode_pack4_rows(packed: jnp.ndarray, rows: jnp.ndarray, n: int):
+    """Gather + nibble-unpack the named rows: [C] row ids ->
+    [C, N] int8 fm (15 -> -1). Pad/negative row ids clamp to row 0 —
+    their lanes are valid=False and never read."""
+    r = packed.shape[0]
+    rows = jnp.clip(rows.astype(jnp.int32), 0, r - 1)
+    pk = packed[rows].astype(jnp.int32)                  # [C, W2]
+    cols = jnp.arange(n, dtype=jnp.int32)
+    byte = jnp.take(pk, cols // 2, axis=1)               # [C, N]
+    v = (byte >> ((cols % 2) * 4)) & 0xF
+    return jnp.where(v == PACK4_MARKER, jnp.int8(-1),
+                     v.astype(jnp.int8))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "group", "steps", "r"))
+def _decode_rle_rows(starts: jnp.ndarray, vals: jnp.ndarray,
+                     offsets: jnp.ndarray, rows: jnp.ndarray, n: int,
+                     group: int, steps: int, r: int):
+    """Run-start search decode: [C] row ids -> [C, N] int8 fm.
+
+    For row ``row`` and source ``s`` the answer is the value of the run
+    covering in-group position ``row % group`` within cell
+    ``(row // group, s)`` — a branchless binary search over the cell's
+    run range (``offsets`` bounds it; ``steps`` = static
+    ``ceil(log2(max cell runs))``). Every cell holds >= 1 run whose
+    start is 0, so the invariant ``starts[lo] <= j`` holds from the
+    first step."""
+    rows = jnp.clip(rows.astype(jnp.int32), 0, r - 1)
+    g = rows // group                                    # [C]
+    j = (rows % group)[:, None].astype(jnp.int32)        # [C, 1]
+    cell = g[:, None] * n + jnp.arange(n, dtype=jnp.int32)[None, :]
+    lo = offsets[cell]                                   # [C, N]
+    hi = offsets[cell + 1]
+    st32 = starts.astype(jnp.int32)
+
+    # branch-free bisection:
+    #   starts[mid] <= j  -> answer in [mid, hi)
+    #   otherwise         -> answer in [lo, mid)
+    def step(_, lohi):
+        lo, hi = lohi
+        narrow = hi - lo > 1
+        mid = (lo + hi) // 2
+        right = (st32[mid] <= j) & narrow
+        lo = jnp.where(right, mid, lo)
+        hi = jnp.where(narrow & ~right, mid, hi)
+        return lo, hi
+
+    lo, _ = jax.lax.fori_loop(0, max(steps, 1), step, (lo, hi))
+    return vals[lo]
+
+
+class CompressedFM:
+    """A compressed-resident first-move shard: the codec, the logical
+    ``(R, N)`` shape, and the device-resident encoded arrays.
+
+    Quacks enough like the raw ``[R, N]`` table for the engine's shape
+    checks (``shape``, ``nbytes``); :meth:`decompress_rows` inflates an
+    arbitrary row set to a dense ``[C, N]`` int8 block — the
+    decompress-at-point-of-use call every serving path funnels
+    through."""
+
+    def __init__(self, codec: str, shape: tuple[int, int],
+                 arrays: dict, group: int = 0, steps: int = 0):
+        self.codec = codec
+        self.shape = tuple(shape)
+        self.arrays = arrays
+        self.group = int(group)
+        self.steps = int(steps)
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(int(a.nbytes) for a in self.arrays.values()))
+
+    @property
+    def packed(self):
+        """The pack4 nibble array — what the Pallas kernel's
+        decompress-on-tile loader stages directly from HBM."""
+        return self.arrays["packed"]
+
+    def decompress_rows(self, rows) -> jnp.ndarray:
+        """Inflate the named rows to a dense [C, N] int8 block (device;
+        bit-identical to the raw table's ``fm[rows]``)."""
+        if self.codec == "pack4":
+            return _decode_pack4_rows(self.arrays["packed"], rows,
+                                      n=self.shape[1])
+        return _decode_rle_rows(
+            self.arrays["starts"], self.arrays["vals"],
+            self.arrays["offsets"], rows, n=self.shape[1],
+            group=self.group, steps=self.steps, r=self.shape[0])
+
+
+def _rle_steps(offsets: np.ndarray) -> int:
+    """Static binary-search depth: ceil(log2(max runs per cell))."""
+    cnt = int(np.max(np.diff(np.asarray(offsets, np.int64)),
+                     initial=1))
+    return max(int(max(cnt - 1, 1)).bit_length(), 1)
+
+
+def make_resident(rows: np.ndarray, codec: str | None = None,
+                  place=None):
+    """Materialize one shard's resident first-move table under the
+    ``DOS_CPD_RESIDENT`` policy (an explicit ``codec`` wins).
+
+    Returns ``(table, codec_used)`` — ``table`` is the placed raw
+    ``jnp`` array for ``raw``, a :class:`CompressedFM` otherwise.
+    ``place`` maps a host array onto the caller's device layout (the
+    engine's replica-lane / mesh-replicated placement); default is a
+    plain ``jnp.asarray``. A requested codec that is not viable
+    DEGRADES to raw and books ``cpd_resident_degraded_total`` — the
+    fit-degrade is a counter, never a fault."""
+    if place is None:
+        place = jnp.asarray
+    req = resident_choice() if codec is None else str(codec)
+    if req not in RESIDENT_CODECS:
+        raise ValueError(f"unknown resident codec {req!r}")
+    rows = np.asarray(rows, np.int8)
+    if req == "raw":
+        out = place(rows)
+        M_RESIDENT_BYTES.set(int(out.nbytes))
+        return out, "raw"
+    rle = encode_rle(rows) if req in ("rle", "auto") else None
+    p4 = encode_pack4(rows) if req in ("pack4", "auto") else None
+    if rle is not None and p4 is not None:
+        # auto: the smaller wins, ties prefer rle (it keeps shrinking
+        # with run coherence; pack4 is a fixed 2x)
+        rle_bytes = sum(int(a.nbytes) for a in rle[:3])
+        if rle_bytes > p4.nbytes:
+            rle = None
+        else:
+            p4 = None
+    if rle is not None:
+        starts, vals, offsets, group = rle
+        fm = CompressedFM(
+            "rle", rows.shape,
+            {"starts": place(starts), "vals": place(vals),
+             "offsets": place(offsets)},
+            group=group, steps=_rle_steps(offsets))
+    elif p4 is not None:
+        fm = CompressedFM("pack4", rows.shape, {"packed": place(p4)})
+    else:
+        log.warning("DOS_CPD_RESIDENT=%s not viable for this %dx%d "
+                    "shard (escape slots / incompressible runs); "
+                    "serving raw", req, *rows.shape)
+        M_RESIDENT_DEGRADED.inc()
+        out = place(rows)
+        M_RESIDENT_BYTES.set(int(out.nbytes))
+        return out, "raw"
+    M_RESIDENT_BYTES.set(fm.nbytes)
+    log.info("resident %s: %dx%d fm %.1f MB -> %.1f MB (%.1fx)",
+             fm.codec, rows.shape[0], rows.shape[1],
+             rows.nbytes / 2**20, fm.nbytes / 2**20,
+             rows.nbytes / max(fm.nbytes, 1))
+    return fm, fm.codec
+
+
+# --------------------------------------------------- on-disk containers
+#
+# A compressed block file is an ordinary .npy holding a self-describing
+# 1-D uint8 container: magic + json header + the encoded arrays' raw
+# bytes. Riding .npy keeps EVERY existing durability path unchanged —
+# atomic writers, crc32 digests, ledger journaling, quarantine/heal,
+# replica copies, adopter catch-up — and those copies now move the
+# compressed bytes (the smaller anti-entropy/catch-up payloads the
+# membership plane wants). Raw blocks are 2-D int8, containers 1-D
+# uint8 with a magic prefix: the two can never be confused.
+
+BLOCK_MAGIC = b"DOSCPDC1"
+
+
+def is_container(arr) -> bool:
+    """Is this loaded block array a compressed container (vs raw
+    2-D int8 fm rows)?"""
+    try:
+        return (arr.ndim == 1 and arr.dtype == np.uint8
+                and arr.shape[0] > len(BLOCK_MAGIC) + 4
+                and bytes(np.asarray(arr[:len(BLOCK_MAGIC)]))
+                == BLOCK_MAGIC)
+    except (AttributeError, TypeError):
+        return False
+
+
+def _container_header(arr) -> tuple[dict, int]:
+    """Parse a container's json header; returns (header, body offset).
+    Raises ValueError on a torn/foreign payload. Reads ONLY the magic +
+    header slice — callers hand in mmap'd block files on the verify
+    path, and converting the whole array would materialize the block
+    just to read a few hundred bytes."""
+    if not is_container(arr):
+        raise ValueError("not a compressed CPD block container")
+    hl_off = len(BLOCK_MAGIC)
+    hlen = int.from_bytes(
+        bytes(np.asarray(arr[hl_off:hl_off + 4], np.uint8)), "little")
+    body = hl_off + 4 + hlen
+    if hlen <= 0 or body > arr.shape[0]:
+        raise ValueError("compressed block header length out of range")
+    try:
+        header = json.loads(bytes(
+            np.asarray(arr[hl_off + 4:body], np.uint8)).decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ValueError(f"compressed block header unparsable: {e}")
+    return header, body
+
+
+def block_codec(arr) -> str | None:
+    """Codec recorded in a container block (None for raw blocks).
+    Header-slice read only — safe to call on an mmap'd block."""
+    if not is_container(arr):
+        return None
+    header, _ = _container_header(arr)
+    return str(header.get("codec"))
+
+
+def encode_block(rows: np.ndarray, codec: str | None):
+    """Encode one block's raw rows for persistence. Returns
+    ``(payload uint8 [nbytes], codec_used)`` or None when the block
+    should be written raw (codec None/raw, or not viable for these
+    rows — each block degrades independently, the manifest records
+    what happened)."""
+    if codec in (None, "raw"):
+        return None
+    rows = np.asarray(rows, np.int8)
+    header: dict = {"codec": None, "shape": list(rows.shape)}
+    rle = encode_rle(rows) if codec in ("rle", "auto") else None
+    p4 = encode_pack4(rows) if codec in ("pack4", "auto") else None
+    if rle is not None and p4 is not None:
+        # auto: the smaller wins, ties prefer rle — the SAME rule as
+        # make_resident's, so on-disk auto blocks persist the codec the
+        # resident policy would pick for the same rows
+        if sum(int(a.nbytes) for a in rle[:3]) > p4.nbytes:
+            rle = None
+        else:
+            p4 = None
+    arrays: list[tuple[str, np.ndarray]] = []
+    if rle is not None:
+        starts, vals, offsets, group = rle
+        header.update(codec="rle", group=group)
+        arrays = [("starts", starts), ("vals", vals),
+                  ("offsets", offsets)]
+    elif p4 is not None:
+        header["codec"] = "pack4"
+        arrays = [("packed", p4)]
+    else:
+        return None
+    header["arrays"] = [[name, str(a.dtype), list(a.shape)]
+                        for name, a in arrays]
+    hb = json.dumps(header).encode()
+    payload = b"".join([BLOCK_MAGIC, len(hb).to_bytes(4, "little"), hb]
+                       + [np.ascontiguousarray(a).tobytes()
+                          for _, a in arrays])
+    return np.frombuffer(payload, np.uint8).copy(), header["codec"]
+
+
+def decode_block_rows(arr) -> np.ndarray:
+    """Container payload -> the raw [C, N] int8 rows it encodes
+    (host-side; bit-identical to what was encoded). Raises ValueError
+    on a torn/foreign payload — callers treat that as a corrupt
+    block."""
+    header, off = _container_header(arr)
+    got: dict[str, np.ndarray] = {}
+    for name, dtype, shape in header.get("arrays", []):
+        size = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        if off + size > arr.shape[0]:
+            raise ValueError(f"compressed block truncated at {name!r}")
+        got[name] = np.frombuffer(
+            bytes(np.asarray(arr[off:off + size], np.uint8)),
+            dtype).reshape(shape)
+        off += size
+    r, n = (int(x) for x in header["shape"])
+    codec = header.get("codec")
+    if codec == "pack4":
+        packed = got["packed"]
+        lo = (packed & 0xF).astype(np.int8)
+        hi = ((packed >> 4) & 0xF).astype(np.int8)
+        v = np.stack([lo, hi], axis=-1).reshape(r, -1)[:, :n]
+        return np.where(v == PACK4_MARKER, np.int8(-1), v)
+    if codec != "rle":
+        raise ValueError(f"unknown compressed block codec {codec!r}")
+    starts = got["starts"].astype(np.int64)
+    vals, offsets = got["vals"], got["offsets"].astype(np.int64)
+    group = int(header["group"])
+    n_groups = -(-r // group)
+    out = np.empty((r, n), np.int8)
+    for gi in range(n_groups):
+        gg = min(group, r - gi * group)
+        o0, o1 = int(offsets[gi * n]), int(offsets[(gi + 1) * n])
+        st = starts[o0:o1]
+        ends = np.empty(o1 - o0, np.int64)
+        ends[:-1] = st[1:]
+        ends[-1] = gg
+        # the last run of each CELL ends at the group height, not at
+        # the next cell's (restarted) first start
+        cell_last = offsets[gi * n + 1:(gi + 1) * n + 1] - 1 - o0
+        ends[cell_last] = gg
+        col = np.repeat(vals[o0:o1], ends - st)       # [N * gg]
+        out[gi * group:gi * group + gg] = col.reshape(n, gg).T
+    return out
+
+
+def maybe_decode_rows(arr) -> np.ndarray:
+    """Raw rows pass through; container payloads decode. The one call
+    every consumer that needs dense rows makes after loading a block."""
+    a = np.asarray(arr)
+    if is_container(a):
+        return decode_block_rows(a)
+    return a

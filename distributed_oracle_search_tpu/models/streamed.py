@@ -300,6 +300,9 @@ class StreamedCPDOracle:
             manifest = json.load(f)
         validate_manifest(manifest, controller, outdir)
         self._blocks: dict[tuple[int, int], np.ndarray] = {}
+        # bounded LRU of DECODED compressed blocks (see _block);
+        # insertion order is the recency order
+        self._decoded: dict[tuple[int, int], np.ndarray] = {}
         # LRU of device-resident [C, N] chunks, key (wid, r0); insertion
         # order IS the recency order (moved-to-end on hit)
         self._chunk_cache: dict[tuple[int, int], jnp.ndarray] = {}
@@ -390,13 +393,41 @@ class StreamedCPDOracle:
             except OSError:
                 pass
 
+    #: decoded compressed blocks kept host-side at once. The streamed
+    #: oracle's whole contract is a bounded working set (row_chunk * N
+    #: plus handles) — caching every decoded block would silently
+    #: re-materialize the raw table exactly when compression matters
+    #: most. Chunks read block-contiguously, so a tiny LRU keeps the
+    #: within-chunk locality and a swept campaign stays bounded.
+    _DECODED_KEEP = 4
+
     def _block(self, wid: int, bid: int) -> np.ndarray:
-        """Memory-mapped block file (cached handle, not cached data)."""
+        """Memory-mapped block file (cached handle, not cached data).
+
+        Compressed-container blocks (``models.resident``) decode on
+        touch — the streamed row reads need dense rows — but the
+        DECODED copies live in a small LRU (``_DECODED_KEEP``), not
+        the unbounded handle cache: raw mmap handles cost pages, a
+        decoded block costs its full dense bytes. The mmap's
+        page-cache-speed contiguous reads apply to raw blocks only."""
+        from .resident import is_container, maybe_decode_rows
+
         key = (wid, bid)
+        hit = self._decoded.pop(key, None)
+        if hit is not None:
+            self._decoded[key] = hit          # refresh recency
+            return hit
         if key not in self._blocks:
-            path = os.path.join(self.outdir, shard_block_name(wid, bid))
-            self._blocks[key] = np.load(path, mmap_mode="r")
-        return self._blocks[key]
+            self._blocks[key] = np.load(
+                os.path.join(self.outdir, shard_block_name(wid, bid)),
+                mmap_mode="r")
+        arr = self._blocks[key]
+        if is_container(arr):
+            arr = maybe_decode_rows(arr)
+            self._decoded[key] = arr
+            while len(self._decoded) > self._DECODED_KEEP:
+                self._decoded.pop(next(iter(self._decoded)))
+        return arr
 
     def _row_range(self, wid: int, r0: int, count: int) -> np.ndarray:
         """Contiguous owned-row slice [count, N] (tail-padded with stuck

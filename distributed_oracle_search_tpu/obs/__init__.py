@@ -245,6 +245,24 @@ lane mesh, ``DOS_MESH_DEVICES``; README "Worker mesh"):
 * ``mesh_collective_seconds`` — on-mesh collective join per mat-family
   row (``CPDOracle.query_mat``: walk + scatter + psum, replacing the
   head-side fan-out/join).
+
+Compressed residency (``models.resident`` — RLE/pack4 CPD shards kept
+compressed in device memory and decompressed only at the point of use,
+``DOS_CPD_RESIDENT``; README "Compressed residency"):
+
+* ``cpd_resident_bytes`` (gauge) — device bytes of the most recently
+  materialized resident first-move table after codec selection (the
+  raw bytes when the codec degraded);
+* ``cpd_resident_degraded_total`` — resident tables whose requested
+  codec was not viable (escape slots for pack4, incompressible runs
+  for rle) and were served raw instead — the fit-degrade is a
+  counter, never a fault;
+* ``cpd_decompress_seconds`` — per-batch decompress-at-use (pack4
+  nibble unpack / rle run-start search) before the walk kernel runs;
+* ``walk_compressed_batches_total`` — table-search batches answered
+  from a compressed-resident shard (the Pallas kernel's
+  decompress-on-tile path or the XLA run-start decode feeding either
+  kernel).
 """
 
 from . import device, fleet, metrics, quantiles, trace

@@ -326,6 +326,16 @@ _KEY_DIRECTIONS = {
     "shard_strong_scaling_rows_per_sec_w8": "higher",
     "shard_strong_scaling_overhead_w8_seconds": "lower",
     "multichip_smoke_ok": "higher",
+    # the compressed-residency family (RLE/pack4 resident CPD shards,
+    # ROADMAP item 1): the resident-bytes ratio and compressed walk
+    # rates improve UP, the per-batch decompress overhead improves
+    # DOWN (its _seconds suffix would catch it — listed so the
+    # family's contract is in one place like the others)
+    "cpd_resident_bytes_ratio": "higher",
+    "compressed_walk_queries_per_sec": "higher",
+    "compressed_raw_walk_queries_per_sec": "higher",
+    "compressed_vs_raw_walk_ratio": "higher",
+    "compressed_decompress_seconds": "lower",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -344,6 +354,10 @@ _KEY_TOLERANCES = {
     "build_delta_vs_full_ratio": 0.2,
     # the multichip smoke is pass/fail: ANY drop (1 -> 0) gates
     "multichip_smoke_ok": 0.0,
+    # the resident-bytes ratio is a structural property of the codec
+    # on a fixed synthetic graph (bytes in / bytes out), not a timing
+    # — a real drop means the encoder stopped compressing
+    "cpd_resident_bytes_ratio": 0.15,
 }
 
 

@@ -27,11 +27,15 @@ chip:
   free-flow moves, diffed costs, exactly the module-header contract of
   ``ops.table_search``.
 
-**The row-tile loader is a seam.** ``_stage_row_direct`` /
-``_stage_row_dma`` materialize one fm row into one tile lane; a
-compressed-CPD tier (ROADMAP item 3) swaps in a decompress-on-tile
-body here — RLE blocks in HBM, raw rows only ever in VMEM — without
-touching the walk loop.
+**The row-tile loader is a seam — now occupied.** ``_stage_row_direct``
+/ ``_stage_row_dma`` materialize one fm row into one tile lane, and the
+compressed-CPD tier (ROADMAP item 1 after the PR 10 re-anchor;
+``models.resident``) plugs in here: under ``packed4=True`` the loaders
+stage the PACK4 nibble row — half the HBM traffic — and the walk
+widens it with an on-chip nibble unpack, so raw rows only ever exist
+in VMEM. RLE-resident shards decompress per batch through the XLA
+run-start search (``CompressedFM.decompress_rows``) before either
+kernel runs; the walk loop itself never changes.
 
 Kernel selection (``DOS_WALK_KERNEL``, via ``utils.env``):
 
@@ -106,50 +110,61 @@ def resolve_walk_kernel(backend: str | None = None) -> str:
 
 
 def pallas_walk_fits(n: int, k: int, m: int, q: int,
-                     n_buckets: int = 0) -> tuple[bool, str]:
+                     n_buckets: int = 0,
+                     codec: str = "raw") -> tuple[bool, str]:
     """Would the fused kernel's VMEM working set fit the budget?
 
     ``n``/``k``/``m`` are the graph's node count, max out-degree, and
     edge count; ``q`` the (padded) batch size. The working set counts
     what the kernel actually holds live per bucket: the double-buffered
-    int8 row tile (``2 * qb * n``) PLUS the loop-resident int32 widening
-    of the active slot (``tl = tile[cur].astype(int32)`` — 4 bytes/lane,
-    twice the whole int8 tile term, the dominant consumer), and the
-    graph tables both as staged blocks and as their flattened loop
-    copies. Returns ``(ok, reason)`` so callers can log the degrade
-    once.
+    row tile (int8 ``2 * qb * n``, HALVED to nibble width under
+    ``codec="pack4"`` — the compressed working set, ROADMAP item 1)
+    PLUS the loop-resident int32 widening of the active slot
+    (``tl = ...astype(int32)`` — 4 bytes/lane, the dominant consumer;
+    the pack4 unpack holds one extra int32 byte-gather temp of the same
+    size while it widens), and the graph tables both as staged blocks
+    and as their flattened loop copies. Returns ``(ok, reason)`` so
+    callers can log the degrade once.
     """
     if q <= 0:
         return True, ""
     nb = pick_buckets(q, n_buckets)
     qb = q // nb
-    tile = 2 * qb * n                          # int8 rows, two slots
+    if codec == "pack4":
+        tile = 2 * qb * ((n + 1) // 2)         # uint8 nibbles, 2 slots
+        unpack_tmp = 4 * qb * n                # int32 byte-gather temp
+    else:
+        tile = 2 * qb * n                      # int8 rows, two slots
+        unpack_tmp = 0
     tile_widened = 4 * qb * n                  # int32 active-slot copy
     # nbr + eid + w_pad int32, staged block + flattened loop copy
     tables = 2 * (2 * n * k * 4 + (m + 1) * 4)
     budget_mb = env_cast("DOS_WALK_VMEM_MB", _VMEM_BUDGET_MB, float)
     if budget_mb <= 0:
         budget_mb = _VMEM_BUDGET_MB
-    need = tile + tile_widened + tables
+    need = tile + tile_widened + unpack_tmp + tables
     if need > budget_mb * 2**20:
         return False, (
             f"fused-walk working set {need / 2**20:.1f} MB "
-            f"(tile 2x{qb}x{n} int8 + int32 widening + tables) over "
+            f"({codec} tile 2x{qb} rows + int32 widening + tables) over "
             f"the {budget_mb:.0f} MB VMEM budget (DOS_WALK_VMEM_MB) — "
             "falling back to the XLA walk")
     return True, ""
 
 
-def choose_walk_kernel(n: int, k: int, m: int, q: int) -> tuple[str, str]:
+def choose_walk_kernel(n: int, k: int, m: int, q: int,
+                       codec: str = "raw") -> tuple[str, str]:
     """The one selection site both serving paths call: resolve the
     ``DOS_WALK_KERNEL`` knob, then degrade an over-budget pallas
-    request to the XLA walk. Returns ``(kernel, why)`` — ``why`` is
-    non-empty exactly when a pallas request fell back, so callers own
-    only their log-once bookkeeping, never the policy."""
+    request to the XLA walk. ``codec`` names the tile the kernel would
+    stage (``pack4`` = the compressed-resident nibble tile). Returns
+    ``(kernel, why)`` — ``why`` is non-empty exactly when a pallas
+    request fell back, so callers own only their log-once bookkeeping,
+    never the policy."""
     kernel = resolve_walk_kernel()
     if kernel != "pallas":
         return kernel, ""
-    fits, why = pallas_walk_fits(n, k, m, q)
+    fits, why = pallas_walk_fits(n, k, m, q, codec=codec)
     if not fits:
         return "xla", why
     return "pallas", ""
@@ -158,10 +173,17 @@ def choose_walk_kernel(n: int, k: int, m: int, q: int) -> tuple[str, str]:
 # ----------------------------------------------------- row-tile loaders
 #
 # THE SEAM: one fm row -> one VMEM tile lane. Everything the walk knows
-# about where rows come from lives in these two functions; a
-# compressed-CPD tier (ROADMAP item 3) replaces the body with
-# decompress-on-tile (RLE block in, raw row out) and the walk loop
-# below never changes.
+# about where rows come from lives in these two functions. The
+# compressed-CPD tier (ROADMAP item 1) uses them unchanged: under
+# ``packed4`` the "row" being staged is the pack4 NIBBLE row (the tile
+# narrows to ceil(n/2) uint8), and decompression happens after the
+# stage — an on-chip nibble unpack where the raw path only widens to
+# int32 — so the walk loop below never changes.
+
+#: pack4 marker nibble for -1 (the streamed wire format's vocabulary,
+#: models.resident.PACK4_MARKER — duplicated: ops must not import
+#: models)
+_PACK4_MARKER = 15
 
 def _stage_row_direct(fm_ref, tile, j, row):
     """Interpret-mode loader: plain ref copy (TPU DMA semaphores do not
@@ -182,12 +204,16 @@ def _stage_row_dma(fm_ref, tile, sem, slot, j, row, wait: bool):
 
 
 def _make_kernel(nb: int, qb: int, n: int, k: int, limit: int,
-                 unroll: int, budget: int | None, use_dma: bool):
+                 unroll: int, budget: int | None, use_dma: bool,
+                 packed4: bool):
     """Build the per-bucket kernel body (static shapes baked in).
 
     ``budget`` is the per-step ``k_moves`` cap (None = the unlimited
     reference default — the compare vanishes from the program, same
-    static specialization as the XLA kernel's).
+    static specialization as the XLA kernel's). ``packed4``: the fm
+    ref holds pack4 nibble rows (``models.resident``) — the staging
+    copies move the HALF-width uint8 rows and the widening step
+    becomes decompress-on-tile (nibble unpack, 15 -> -1).
     """
 
     def _stage_bucket(rows_sref, fm_ref, tile, sem, slot, base,
@@ -203,6 +229,19 @@ def _make_kernel(nb: int, qb: int, n: int, k: int, limit: int,
             return 0
 
         jax.lax.fori_loop(0, qb, stage, 0)
+
+    def widen(staged):
+        """Staged tile slot -> the int32 [qb, n] slot table the walk
+        gathers from. Raw tiles only widen; pack4 tiles DECOMPRESS
+        here — a byte gather + nibble shift per column, the on-chip
+        half of the compressed-resident scheme."""
+        if not packed4:
+            return staged.astype(jnp.int32)
+        pk = staged.astype(jnp.int32)                  # [qb, ceil(n/2)]
+        cols = jnp.arange(n, dtype=jnp.int32)
+        byte = jnp.take(pk, cols // 2, axis=1)         # [qb, n]
+        v = (byte >> ((cols % 2) * 4)) & 0xF
+        return jnp.where(v == _PACK4_MARKER, jnp.int32(-1), v)
 
     def kernel(rows_sref, s_ref, t_ref, valid_ref, fm_ref, nbr_ref,
                eid_ref, w_ref, cost_ref, plen_ref, fin_ref, tile,
@@ -229,12 +268,12 @@ def _make_kernel(nb: int, qb: int, n: int, k: int, limit: int,
 
             _stage_bucket(rows_sref, fm_ref, tile, sem, cur, i * qb,
                           wait=True)
-            tl = tile[cur].astype(jnp.int32)               # [qb, n]
+            tl = widen(tile[cur])                          # [qb, n]
         else:
             sem = None
             _stage_bucket(rows_sref, fm_ref, tile, sem, 0, i * qb,
                           wait=False)
-            tl = tile[...].astype(jnp.int32)               # [qb, n]
+            tl = widen(tile[...])                          # [qb, n]
 
         s_v = s_ref[0, :]
         t_v = t_ref[0, :]
@@ -292,10 +331,10 @@ def _make_kernel(nb: int, qb: int, n: int, k: int, limit: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("k_moves", "max_steps", "unroll",
-                                    "n_buckets", "interpret"))
+                                    "n_buckets", "interpret", "packed4"))
 def _pallas_walk(dg: DeviceGraph, fm, t_rows, s, t, w_query_pad, valid,
                  k_moves: int, max_steps: int, unroll: int,
-                 n_buckets: int, interpret: bool):
+                 n_buckets: int, interpret: bool, packed4: bool):
     q = s.shape[0]
     n = dg.n
     k = dg.k
@@ -312,8 +351,11 @@ def _pallas_walk(dg: DeviceGraph, fm, t_rows, s, t, w_query_pad, valid,
     w2 = w_query_pad.astype(jnp.int32).reshape(1, -1)
 
     kernel = _make_kernel(nb, qb, n, k, limit, unroll, budget,
-                          use_dma=not interpret)
-    tile_shape = ((2, qb, n) if not interpret else (qb, n))
+                          use_dma=not interpret, packed4=packed4)
+    # the staged tile matches the fm row width: full int8 rows raw,
+    # half-width uint8 nibble rows under pack4 residency
+    width = int(fm.shape[1])
+    tile_shape = ((2, qb, width) if not interpret else (qb, width))
     scratch = [pltpu.VMEM(tile_shape, fm.dtype)]
     if not interpret:
         scratch.append(pltpu.SemaphoreType.DMA((2,)))
@@ -351,7 +393,8 @@ def _pallas_walk(dg: DeviceGraph, fm, t_rows, s, t, w_query_pad, valid,
 def pallas_walk_batch(dg: DeviceGraph, fm, t_rows, s, t, w_query_pad,
                       valid=None, k_moves: int = -1, max_steps: int = 0,
                       unroll: int = 8, n_buckets: int = 0,
-                      interpret: bool | None = None):
+                      interpret: bool | None = None,
+                      packed4: bool = False):
     """Fused-kernel drop-in for
     :func:`.table_search.table_search_batch` — same parameters, same
     ``(cost, plen, finished)`` contract, bit-identical answers.
@@ -360,6 +403,13 @@ def pallas_walk_batch(dg: DeviceGraph, fm, t_rows, s, t, w_query_pad,
     how the CPU tier-1 parity suite executes the kernel); the
     remaining knobs mirror the XLA kernel's and share
     :func:`.table_search.pick_buckets` as the grid resolver.
+
+    ``packed4``: ``fm`` is the pack4-compressed resident shard
+    (``[R, ceil(N/2)]`` uint8 nibble rows, ``models.resident``); the
+    row-tile loader stages the packed rows and the kernel unpacks
+    on-chip — decompress inside the staging DMA, the compressed
+    working set :func:`pallas_walk_fits` accounts under
+    ``codec="pack4"``.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -372,4 +422,4 @@ def pallas_walk_batch(dg: DeviceGraph, fm, t_rows, s, t, w_query_pad,
     return _pallas_walk(dg, fm, t_rows, s, t, w_query_pad, valid,
                         int(k_moves), int(max_steps), int(unroll),
                         pick_buckets(q, int(n_buckets)),
-                        bool(interpret))
+                        bool(interpret), bool(packed4))

@@ -77,6 +77,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "copied from digest-valid primaries when "
                         "sharing a filesystem, recomputed otherwise). "
                         "Default: DOS_REPLICATION or 1")
+    p.add_argument("--codec", default=None,
+                   choices=["raw", "pack4", "rle", "auto"],
+                   help="persist blocks compressed (models.resident "
+                        "RLE/pack4 containers; per-block degrade to "
+                        "raw when not viable). Default: the "
+                        "DOS_CPD_RESIDENT knob (raw = legacy format)")
     p.add_argument("--metrics-dump", default="",
                    help="write a JSON obs-metrics snapshot here on exit "
                         "(build_blocks_resumed_total etc.)")
@@ -128,7 +134,7 @@ def main(argv=None) -> int:
     written = build_worker_shard(graph, dc, args.workerid, outdir,
                                  chunk=args.chunk,
                                  resume=not args.no_resume,
-                                 method=args.method)
+                                 method=args.method, codec=args.codec)
     n_replica = 0
     if dc.replication > 1:
         from ..models.cpd import build_replica_shards

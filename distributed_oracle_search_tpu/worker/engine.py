@@ -81,6 +81,11 @@ M_MESH_WALK = obs_metrics.counter(
     "mesh_walk_batches_total",
     "table-search batches split across the worker's mesh lanes "
     "(per-device bucket subsets under shard_map, bit-identical unsort)")
+M_WALK_COMPRESSED = obs_metrics.counter(
+    "walk_compressed_batches_total",
+    "table-search batches answered from a compressed-resident CPD "
+    "shard (DOS_CPD_RESIDENT: pack4 decompress-on-tile in the Pallas "
+    "kernel, or the XLA run-start decode feeding either kernel)")
 
 
 def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
@@ -106,6 +111,7 @@ def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
         M_BLOCKS_CORRUPT, M_BLOCKS_VERIFIED, check_manifest_version,
         heal_block, load_verified_block, read_manifest, shard_block_name,
     )
+    from ..models.resident import maybe_decode_rows
 
     manifest: dict | None = None
     try:
@@ -158,7 +164,10 @@ def load_shard_rows(outdir: str, wid: int, dc=None, graph=None,
             # only digest-checked blocks count as verified (same rule
             # as CPDOracle.load)
             M_BLOCKS_VERIFIED.inc()
-        parts.append(rows)
+        # compressed containers (models.resident) inflate to dense rows
+        # here; whether the RESIDENT table re-compresses is the
+        # caller's policy (ShardEngine._make_resident)
+        parts.append(maybe_decode_rows(rows))
     return np.concatenate(parts, axis=0)
 
 
@@ -228,8 +237,13 @@ class ShardEngine:
         #: device-batch rows per A* chunk; the deadline is checked
         #: between chunks (first chunk always runs)
         self.astar_chunk = 1024
+        #: resident-codec bookkeeping (statusz / compressed bench):
+        #: what DOS_CPD_RESIDENT actually resolved to for THIS shard
+        #: and the device bytes it occupies ("raw"/0 for astar engines)
+        self.resident_codec = "raw"
+        self.resident_bytes = 0
         if alg == "table-search":  # astar needs no first-move shard
-            self.fm = self._place(load_shard_rows(
+            self.fm = self._make_resident(load_shard_rows(
                 outdir, self.shard, dc=dc, graph=graph,
                 replica=self.replica))
             owned = dc.owned(self.shard)
@@ -302,6 +316,20 @@ class ShardEngine:
                                   replicated(self.mesh))
         return jnp.asarray(arr)
 
+    def _make_resident(self, rows) -> object:
+        """Materialize the resident first-move table under the
+        ``DOS_CPD_RESIDENT`` policy (``models.resident``): the placed
+        raw array (byte-identical legacy) or a :class:`CompressedFM`
+        whose pack4/rle arrays live compressed in device memory and
+        inflate per batch at the point of use. Placement (replica
+        lane / mesh-replicated) is the same as the raw table's."""
+        from ..models.resident import make_resident
+
+        fm, codec = make_resident(rows, place=self._place)
+        self.resident_codec = codec
+        self.resident_bytes = int(fm.nbytes)
+        return fm
+
     # ---------------------------------------------------------- promotion
     def _fm_for(self, difffile: str):
         """The table a batch walks: the promoted epoch table ONLY when
@@ -373,7 +401,9 @@ class ShardEngine:
                             "already-promoted epoch %d", self.wid,
                             epoch, cur[0])
                 return False
-            self._fm_promoted = (int(epoch), self._place(rows))
+            # the promoted table rides the same resident-codec policy
+            # as the base one (compressed residency applies per table)
+            self._fm_promoted = (int(epoch), self._make_resident(rows))
             self.index_epoch = int(epoch)
         log.info("worker %d: promoted shard %d to diff-epoch %d index "
                  "(%s)", self.wid, self.shard, epoch, new_outdir)
@@ -435,6 +465,7 @@ class ShardEngine:
         """
         import jax
         import jax.numpy as jnp
+        from ..models.resident import M_DECOMPRESS, CompressedFM
         from ..ops.pallas_walk import choose_walk_kernel, pallas_walk_batch
         from ..ops.table_search import extract_paths, table_search_batch
 
@@ -535,6 +566,21 @@ class ShardEngine:
                 shape_key = self.astar_chunk
             else:
                 shape_key = qpad
+            # compressed residency (DOS_CPD_RESIDENT, models.resident):
+            # a pack4 shard feeds the Pallas kernel's decompress-on-
+            # tile loader DIRECTLY (packed rows stage through the DMA
+            # tile, nibbles unpack on-chip); every other compressed
+            # case — rle, mesh lanes, extraction, the XLA kernel, the
+            # chunked-deadline path — inflates exactly the batch's
+            # distinct target rows first (the XLA run-start decode:
+            # decompress at the point of use, raw rows transient)
+            compressed = isinstance(fm_tbl, CompressedFM)
+            tile_codec = ("pack4" if (compressed
+                                      and fm_tbl.codec == "pack4"
+                                      and not self._lane_split
+                                      and not extracting
+                                      and config.sig_k <= 0)
+                          else "raw")
             # kernel selection (DOS_WALK_KERNEL): the Pallas-fused walk
             # on real TPU backends under `auto`, the XLA walk otherwise
             # — with a VMEM-fit degrade so an oversized shard falls
@@ -550,12 +596,23 @@ class ShardEngine:
             kernel, why = choose_walk_kernel(
                 self.dg.n, self.dg.k, int(self.dg.w_pad.shape[0]) - 1,
                 max(call_q // self.n_lanes, 1) if self._lane_split
-                else call_q)
+                else call_q, codec=tile_codec)
             if why and not self._walk_fallback_logged:
                 log.warning("%s", why)
                 self._walk_fallback_logged = True
-            walk_fn = (pallas_walk_batch if kernel == "pallas"
-                       else table_search_batch)
+            use_tile_pack4 = (tile_codec == "pack4"
+                              and kernel == "pallas")
+            if kernel == "pallas":
+                p4 = use_tile_pack4
+
+                def walk_fn(dgx, fmx, r_, s_, t_, w_, valid=None,
+                            k_moves=-1):
+                    return pallas_walk_batch(dgx, fmx, r_, s_, t_, w_,
+                                             valid=valid,
+                                             k_moves=k_moves,
+                                             packed4=p4)
+            else:
+                walk_fn = table_search_batch
             (M_WALK_PALLAS if kernel == "pallas" else M_WALK_XLA).inc()
             jit_key = (self.alg, shape_key, config.k_moves, extracting,
                        config.sig_k if config.sig_k > 0 else 0, kernel)
@@ -564,6 +621,32 @@ class ShardEngine:
                 # ones (and per lane count): bookkeeping stays split
                 jit_key = jit_key + (("lanes", self.n_lanes),)
                 M_MESH_WALK.inc()
+            fm_walk = fm_tbl
+            if compressed:
+                M_WALK_COMPRESSED.inc()
+                td0 = time.perf_counter()
+                if use_tile_pack4:
+                    fm_walk = fm_tbl.packed
+                else:
+                    # inflate the batch's DISTINCT target rows once and
+                    # remap the row ids onto the dense block — bounded
+                    # by the batch, freed with it; bit-identical to
+                    # walking the raw table (tests pin it)
+                    urows, rinv = np.unique(rows[:nu],
+                                            return_inverse=True)
+                    rpad = 1 << (len(urows) - 1).bit_length()
+                    rows_u = np.zeros(rpad, np.int32)
+                    rows_u[:len(urows)] = urows
+                    fm_walk = fm_tbl.decompress_rows(
+                        self._place(rows_u))
+                    jax.block_until_ready(fm_walk)
+                    rows = np.zeros(qpad, np.int32)
+                    rows[:nu] = rinv.reshape(-1).astype(np.int32)
+                M_DECOMPRESS.observe(time.perf_counter() - td0)
+                # compressed programs compile separately (the fm
+                # operand's shape/dtype differs per codec + row pad)
+                jit_key = jit_key + (
+                    ("resident", fm_tbl.codec, int(fm_walk.shape[0])),)
         first_call = jit_key not in self._jit_seen
         if self.alg == "astar":
             deadline = t1 + config.time / 1e9 if config.time else None
@@ -589,10 +672,10 @@ class ShardEngine:
                 from ..parallel.sharded import walk_lanes
 
                 return walk_lanes(
-                    self.dg, fm_tbl, rows_h, s_h, t_h, valid_h, w_pad,
+                    self.dg, fm_walk, rows_h, s_h, t_h, valid_h, w_pad,
                     self.mesh, k_moves=config.k_moves, kernel=kernel)
             return walk_fn(
-                self.dg, fm_tbl, jnp.asarray(rows_h), jnp.asarray(s_h),
+                self.dg, fm_walk, jnp.asarray(rows_h), jnp.asarray(s_h),
                 jnp.asarray(t_h), w_pad, valid=jnp.asarray(valid_h),
                 k_moves=config.k_moves)
 
@@ -640,7 +723,7 @@ class ShardEngine:
                 break
         if config.extract and config.k_moves > 0:
             nodes, moves = extract_paths(
-                self.dg, fm_tbl, jnp.asarray(rows), jnp.asarray(s),
+                self.dg, fm_walk, jnp.asarray(rows), jnp.asarray(s),
                 jnp.asarray(t), k=config.k_moves)
             nodes = np.asarray(nodes[:nu], np.int64)[unsort]
             moves = np.asarray(moves[:nu], np.int64)[unsort]
@@ -654,7 +737,7 @@ class ShardEngine:
             # k_moves, so the walk's move budget — and therefore every
             # answer — is untouched
             nodes, moves = extract_paths(
-                self.dg, fm_tbl, jnp.asarray(rows), jnp.asarray(s),
+                self.dg, fm_walk, jnp.asarray(rows), jnp.asarray(s),
                 jnp.asarray(t), k=int(config.sig_k))
             nodes = np.asarray(nodes[:nu], np.int64)[unsort]
             moves = np.asarray(moves[:nu], np.int64)[unsort]
@@ -687,20 +770,22 @@ class ShardEngine:
                 # capture's AOT lower sees only array operands (its
                 # interpret/bucket resolution runs at trace time)
                 km = config.k_moves
+                p4c = use_tile_pack4
 
                 def _cap_fn(dgx, fmx, r_, s_, t_, w_, v_):
                     return pallas_walk_batch(dgx, fmx, r_, s_, t_, w_,
-                                             valid=v_, k_moves=km)
+                                             valid=v_, k_moves=km,
+                                             packed4=p4c)
 
                 obs_device.capture(
                     f"table-search[pallas]/q{cap_n}/k{config.k_moves}",
-                    _cap_fn, self.dg, fm_tbl, jnp.asarray(rows[sl]),
+                    _cap_fn, self.dg, fm_walk, jnp.asarray(rows[sl]),
                     jnp.asarray(s[sl]), jnp.asarray(t[sl]), w_pad,
                     jnp.asarray(valid[sl]))
             else:
                 obs_device.capture(
                     f"table-search/q{cap_n}/k{config.k_moves}",
-                    table_search_batch, self.dg, fm_tbl,
+                    table_search_batch, self.dg, fm_walk,
                     jnp.asarray(rows[sl]), jnp.asarray(s[sl]),
                     jnp.asarray(t[sl]), w_pad,
                     valid=jnp.asarray(valid[sl]), k_moves=config.k_moves)

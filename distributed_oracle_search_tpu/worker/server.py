@@ -675,6 +675,13 @@ class FifoServer:
             "devices": int(getattr(eng, "n_lanes", 1) or 1),
             "axis": "lane",
         }
+        # compressed residency: what DOS_CPD_RESIDENT resolved to for
+        # this shard and the device bytes the table occupies (older
+        # workers omit the key; `dos-obs top` renders a blank)
+        out["resident"] = {
+            "codec": str(getattr(eng, "resident_codec", "raw")),
+            "bytes": int(getattr(eng, "resident_bytes", 0) or 0),
+        }
         out["replica_shards_loaded"] = sorted(
             s for s in self._replica_engines if s != self.wid)
         if self.dc.replication > 1:

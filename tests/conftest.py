@@ -27,6 +27,12 @@ os.environ.setdefault("DOS_LOCK_CHECK", "1")
 # whole suite to interpret speed nor let the parity suite silently
 # stop comparing the two kernels against each other.
 os.environ["DOS_WALK_KERNEL"] = "xla"
+# same rule for the resident-codec knob: raw residency is the reference
+# path every existing suite pins bit-identity against, and compressed
+# residency is exercised EXPLICITLY by tests/test_compressed.py (it
+# opts in per test). A container env carrying DOS_CPD_RESIDENT=rle
+# must not silently flip every engine in the suite.
+os.environ["DOS_CPD_RESIDENT"] = "raw"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,705 @@
+"""Compressed-residency parity suite (DOS_CPD_RESIDENT, models.resident).
+
+The compressed-resident CPD tier must be invisible in the answers:
+every codec (pack4 / rle / auto) must produce BIT-identical results to
+the raw-resident engine across both walk kernels (XLA and the Pallas
+kernel's decompress-on-tile path in interpret mode), every mesh lane
+count, diffed weights, and the awkward queries (s==t, duplicates,
+unreachable); on disk the codec containers must ride the ordinary
+digest/ledger/verify/heal/delta machinery unchanged. Degrades (codec
+not viable) book a counter and serve raw — never a fault.
+"""
+
+import glob
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.data import (
+    synth_diff, synth_scenario,
+)
+from distributed_oracle_search_tpu.data.formats import write_diff
+from distributed_oracle_search_tpu.data.graph import Graph
+from distributed_oracle_search_tpu.models import resident
+from distributed_oracle_search_tpu.models.cpd import (
+    CPDOracle, build_worker_shard, delta_build_index, read_manifest,
+    verify_exit_code, verify_index, write_index_manifest,
+)
+from distributed_oracle_search_tpu.obs import fleet
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.transport.wire import RuntimeConfig
+from distributed_oracle_search_tpu.utils.atomicio import (
+    sweep_stale_artifacts,
+)
+from distributed_oracle_search_tpu.worker.engine import (
+    ShardEngine, load_shard_rows,
+)
+
+pytestmark = pytest.mark.compressed
+
+CODECS = ("pack4", "rle", "auto")
+
+
+def _counter(name: str) -> int:
+    return int(obs_metrics.REGISTRY.snapshot()["counters"].get(name, 0))
+
+
+def _structured_fm(r: int = 600, n: int = 300, seed: int = 0):
+    """A run-coherent [r, n] int8 table (the target-axis coherence real
+    CPD shards have) with slots 0..5 and -1 holes."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(-1, 6, size=(1, n), dtype=np.int64)
+    fm = np.repeat(base, r, axis=0).astype(np.int8)
+    flip = rng.random(fm.shape) < 0.03
+    fm[flip] = rng.integers(-1, 6, size=int(flip.sum()))
+    return fm
+
+
+# ------------------------------------------------------ codec units
+
+def test_resident_choice_knob(monkeypatch):
+    monkeypatch.delenv("DOS_CPD_RESIDENT", raising=False)
+    assert resident.resident_choice() == "raw"
+    for raw, want in (("rle", "rle"), ("PACK4", "pack4"),
+                      ("auto", "auto"), ("bogus", "raw"), ("", "raw")):
+        monkeypatch.setenv("DOS_CPD_RESIDENT", raw)
+        assert resident.resident_choice() == want, raw
+
+
+def test_rle_group_knob(monkeypatch):
+    monkeypatch.delenv("DOS_CPD_RLE_GROUP", raising=False)
+    assert resident.rle_group_rows() == resident._RLE_GROUP_DEFAULT
+    monkeypatch.setenv("DOS_CPD_RLE_GROUP", "128")
+    assert resident.rle_group_rows() == 128
+    for bad in ("0", "1", "999999", "nope"):
+        monkeypatch.setenv("DOS_CPD_RLE_GROUP", bad)
+        assert resident.rle_group_rows() == resident._RLE_GROUP_DEFAULT
+
+
+def test_pack4_roundtrip_and_escape_refusal():
+    fm = _structured_fm()
+    packed = resident.encode_pack4(fm)
+    assert packed is not None
+    tbl = resident.CompressedFM("pack4", fm.shape, {"packed": packed})
+    rows = np.r_[0:7, 593:600, 41]
+    got = np.asarray(tbl.decompress_rows(np.asarray(rows, np.int32)))
+    np.testing.assert_array_equal(got, fm[rows])
+    # a single slot >= 14 (the wire format's escape regime) refuses —
+    # the resident codec has no scatter pass to apply escapes with
+    esc = fm.copy()
+    esc[3, 5] = 14
+    assert resident.encode_pack4(esc) is None
+
+
+@pytest.mark.parametrize("group", (64, 100, 4096))
+def test_rle_roundtrip_groups(group):
+    """Multi-group, partial-last-group, odd-width tables all decode
+    bit-identically (device search decode AND host container decode)."""
+    fm = _structured_fm(r=597, n=299, seed=2)
+    enc = resident.encode_rle(fm, group=group)
+    assert enc is not None
+    starts, vals, offsets, g = enc
+    tbl = resident.CompressedFM(
+        "rle", fm.shape,
+        {"starts": starts, "vals": vals, "offsets": offsets},
+        group=g, steps=resident._rle_steps(offsets))
+    got = np.asarray(tbl.decompress_rows(
+        np.arange(fm.shape[0], dtype=np.int32)))
+    np.testing.assert_array_equal(got, fm)
+    # arbitrary (repeating) row subsets too — the batch shape
+    rows = np.array([0, 0, 17, 596, 64, 63, 100, 596], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(tbl.decompress_rows(rows)), fm[rows])
+
+
+def test_rle_incompressible_returns_none():
+    rng = np.random.default_rng(1)
+    junk = rng.integers(-1, 14, size=(128, 129)).astype(np.int8)
+    assert resident.encode_rle(junk) is None
+    assert resident.encode_block(junk, "rle") is None
+
+
+def test_make_resident_degrade_books_counter():
+    """A requested codec that is not viable serves raw and books the
+    degrade counter — never a fault."""
+    rng = np.random.default_rng(1)
+    junk = rng.integers(-1, 30, size=(128, 129)).astype(np.int8)
+    before = _counter("cpd_resident_degraded_total")
+    tbl, used = resident.make_resident(junk, codec="auto")
+    assert used == "raw"
+    assert _counter("cpd_resident_degraded_total") == before + 1
+    np.testing.assert_array_equal(np.asarray(tbl), junk)
+
+
+def test_container_roundtrip_and_torn_payloads():
+    fm = _structured_fm()
+    for codec in ("rle", "pack4"):
+        payload, used = resident.encode_block(fm, codec)
+        assert used == codec
+        assert resident.is_container(payload)
+        assert resident.block_codec(payload) == codec
+        np.testing.assert_array_equal(
+            resident.decode_block_rows(payload), fm)
+        assert payload.nbytes < fm.nbytes
+    # raw blocks pass through untouched
+    assert not resident.is_container(fm)
+    np.testing.assert_array_equal(resident.maybe_decode_rows(fm), fm)
+    # a truncated container raises ValueError (callers book corrupt)
+    payload, _ = resident.encode_block(fm, "rle")
+    with pytest.raises(ValueError):
+        resident.decode_block_rows(payload[:len(payload) // 2])
+    # a foreign uint8 array is not a container
+    assert not resident.is_container(
+        np.zeros(64, np.uint8))
+
+
+def test_pallas_fits_accounts_compressed_tile(monkeypatch):
+    """The VMEM-fit check models the pack4 working set honestly: the
+    staged tile HALVES (nibble rows — the HBM-traffic win) but the
+    on-chip unpack holds an extra int32 temp, so the pack4 working set
+    is strictly LARGER than raw's — a budget between the two admits
+    raw and degrades pack4, naming the codec in the reason."""
+    from distributed_oracle_search_tpu.ops.pallas_walk import (
+        pallas_walk_fits,
+    )
+
+    n, k, m, q = 40_000, 4, 120_000, 4096
+    # this shape needs ~238 MB raw / ~355 MB pack4 (qb=1024 buckets);
+    # a budget between the two separates the codecs
+    monkeypatch.setenv("DOS_WALK_VMEM_MB", "300")
+    ok_raw, _ = pallas_walk_fits(n, k, m, q, codec="raw")
+    ok_p4, why_p4 = pallas_walk_fits(n, k, m, q, codec="pack4")
+    assert ok_raw
+    assert not ok_p4 and "pack4" in why_p4 and "VMEM budget" in why_p4
+    monkeypatch.setenv("DOS_WALK_VMEM_MB", "100")
+    ok_raw, why_raw = pallas_walk_fits(n, k, m, q, codec="raw")
+    assert not ok_raw and "VMEM budget" in why_raw
+
+
+# ----------------------------------------------------- engine parity
+
+@pytest.fixture(scope="module")
+def dc1(toy_graph):
+    # small blocks: the disk suite needs MULTI-block indexes so one
+    # corrupt container degrades (exit 3) instead of killing the index
+    return DistributionController("tpu", None, 1, toy_graph.n,
+                                  block_size=16)
+
+
+@pytest.fixture(scope="module")
+def shard_dir(toy_graph, dc1, tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("comp-shard"))
+    build_worker_shard(toy_graph, dc1, 0, d, chunk=16)
+    write_index_manifest(d, dc1)
+    return d
+
+
+@pytest.fixture(scope="module")
+def diff_file(toy_graph, tmp_path_factory):
+    d = tmp_path_factory.mktemp("comp-diff")
+    path = str(d / "t.diff")
+    write_diff(path, *synth_diff(toy_graph, frac=0.3, seed=3))
+    return path
+
+
+@pytest.fixture(scope="module")
+def walk_queries(toy_graph, toy_queries):
+    """Scenario plus the awkward rows: zero-length (s==t) and
+    duplicate pairs — dedup/unsort must survive the row remap."""
+    q = np.asarray(toy_queries, np.int64)
+    extra = np.array([[3, 3], [0, 0], q[0].tolist(), q[0].tolist(),
+                      q[5].tolist()], np.int64)
+    return np.concatenate([q, extra], axis=0)
+
+
+@pytest.fixture(scope="module")
+def baseline(toy_graph, dc1, shard_dir, walk_queries, diff_file):
+    """Raw-resident engine answers: free-flow and diffed."""
+    eng = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    assert eng.resident_codec == "raw"    # conftest pins the knob
+    rc = RuntimeConfig()
+    free = eng.answer(walk_queries, rc)[:3]
+    diffed = eng.answer(walk_queries, rc, diff_file)[:3]
+    return free, diffed
+
+
+def _codec_engine(monkeypatch, codec, *args, **kwargs):
+    monkeypatch.setenv("DOS_CPD_RESIDENT", codec)
+    eng = ShardEngine(*args, **kwargs)
+    if codec in ("pack4", "rle"):
+        # both codecs are viable on the toy shard; the engine must not
+        # have silently degraded or the parity below proves nothing
+        assert eng.resident_codec == codec
+        assert 0 < eng.resident_bytes < eng.fm.shape[0] * eng.fm.shape[1]
+    return eng
+
+
+@pytest.mark.parametrize("codec", CODECS)
+@pytest.mark.parametrize("kernel", ("xla", "pallas"))
+def test_walk_parity(monkeypatch, toy_graph, dc1, shard_dir,
+                     walk_queries, diff_file, baseline, codec, kernel):
+    """Compressed residency bit-identical to raw: free-flow AND
+    diffed, duplicates and s==t included, both walk kernels (pallas in
+    interpret mode — pack4 exercises decompress-on-tile)."""
+    monkeypatch.setenv("DOS_WALK_KERNEL", kernel)
+    eng = _codec_engine(monkeypatch, codec, toy_graph, dc1, 0,
+                        shard_dir)
+    rc = RuntimeConfig()
+    before = _counter("walk_compressed_batches_total")
+    for want, diff in zip(baseline, ("-", diff_file)):
+        got = eng.answer(walk_queries, rc, diff)[:3]
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+    assert _counter("walk_compressed_batches_total") == before + 2
+
+
+@pytest.mark.parametrize("lanes", (1, 2, 4, 8))
+def test_walk_parity_mesh_lanes(monkeypatch, toy_graph, dc1, shard_dir,
+                                walk_queries, diff_file, baseline,
+                                lanes):
+    """Every mesh lane count serves from compressed residency through
+    the XLA decompress path, bit-identically."""
+    monkeypatch.setenv("DOS_MESH_DEVICES", str(lanes))
+    eng = _codec_engine(monkeypatch, "rle", toy_graph, dc1, 0,
+                        shard_dir)
+    assert eng.n_lanes == lanes
+    rc = RuntimeConfig()
+    for want, diff in zip(baseline, ("-", diff_file)):
+        got = eng.answer(walk_queries, rc, diff)[:3]
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_walk_parity_unreachable(monkeypatch, tmp_path):
+    """Unreachable targets (-1 rows on a disconnected graph) decode
+    and answer identically to raw."""
+    # two disconnected 2-cliques: 0-1 and 2-3
+    src = np.array([0, 1, 2, 3])
+    dst = np.array([1, 0, 3, 2])
+    w = np.array([5, 5, 7, 7])
+    xs = np.array([0, 1, 10, 11])
+    ys = np.zeros(4, np.int64)
+    g = Graph(xs, ys, src, dst, w)
+    dc = DistributionController("tpu", None, 1, g.n)
+    d = str(tmp_path / "disc")
+    build_worker_shard(g, dc, 0, d, chunk=4)
+    q = np.array([[0, 1], [0, 3], [2, 1], [3, 2], [1, 1]], np.int64)
+    rc = RuntimeConfig()
+    monkeypatch.delenv("DOS_CPD_RESIDENT", raising=False)
+    want = ShardEngine(g, dc, 0, d).answer(q, rc)[:3]
+    assert not np.asarray(want[2])[[1, 2]].any()   # cross-clique fails
+    eng = _codec_engine(monkeypatch, "pack4", g, dc, 0, d)
+    for a, b in zip(want, eng.answer(q, rc)[:3]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_chunked_deadline_under_compression(monkeypatch, toy_graph, dc1,
+                                            shard_dir, walk_queries,
+                                            diff_file):
+    """The ns-budget chunked path slices the remapped rows into the
+    SAME decompressed block; a generous budget answers everything,
+    bit-identical to raw."""
+    base = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    eng = _codec_engine(monkeypatch, "rle", toy_graph, dc1, 0,
+                        shard_dir)
+    base.astar_chunk = eng.astar_chunk = 16       # force chunking
+    rc = RuntimeConfig(time=10**13)
+    for a, b in zip(base.answer(walk_queries, rc, diff_file)[:3],
+                    eng.answer(walk_queries, rc, diff_file)[:3]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_extract_and_sig_under_compression(monkeypatch, toy_graph, dc1,
+                                           shard_dir, walk_queries):
+    """--extract prefixes and sig_k signatures extract from the
+    decompressed rows, unchanged (pack4 too: extraction opts out of
+    the on-tile path and decompresses)."""
+    base = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    for codec in ("rle", "pack4"):
+        eng = _codec_engine(monkeypatch, codec, toy_graph, dc1, 0,
+                            shard_dir)
+        for rc in (RuntimeConfig(extract=True, k_moves=6),
+                   RuntimeConfig(sig_k=4)):
+            for a, b in zip(base.answer(walk_queries, rc)[:3],
+                            eng.answer(walk_queries, rc)[:3]):
+                np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(base.last_paths[0],
+                                          eng.last_paths[0])
+            np.testing.assert_array_equal(base.last_paths[1],
+                                          eng.last_paths[1])
+
+
+def test_replica_lane_placement(monkeypatch, toy_graph, dc1, shard_dir,
+                                walk_queries, baseline):
+    """A replica engine's COMPRESSED arrays pin to its mesh lane
+    device (the PR 13 placement), answers unchanged."""
+    monkeypatch.setenv("DOS_MESH_DEVICES", "4")
+    monkeypatch.setenv("DOS_CPD_RESIDENT", "rle")
+    eng = ShardEngine(toy_graph, dc1, 0, shard_dir, replica=2)
+    assert eng.resident_codec == "rle"
+    for arr in eng.fm.arrays.values():
+        assert set(arr.devices()) == {jax.devices()[2 % 4]}
+    rc = RuntimeConfig()
+    for a, b in zip(baseline[0], eng.answer(walk_queries, rc)[:3]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_decompress_metrics_move(monkeypatch, toy_graph, dc1, shard_dir,
+                                 walk_queries):
+    """cpd_decompress_seconds observes per batch; the resident gauge
+    reports the compressed bytes; raw engines move neither."""
+    def _snap():
+        s = obs_metrics.REGISTRY.snapshot()
+        return (s["histograms"].get("cpd_decompress_seconds",
+                                    {}).get("count", 0),
+                s["gauges"].get("cpd_resident_bytes", 0))
+
+    eng = _codec_engine(monkeypatch, "rle", toy_graph, dc1, 0,
+                        shard_dir)
+    n0, gauge = _snap()
+    assert gauge == eng.resident_bytes
+    eng.answer(walk_queries, RuntimeConfig())
+    assert _snap()[0] == n0 + 1
+    monkeypatch.setenv("DOS_CPD_RESIDENT", "raw")
+    raw_eng = ShardEngine(toy_graph, dc1, 0, shard_dir)
+    n1, _ = _snap()
+    raw_eng.answer(walk_queries, RuntimeConfig())
+    assert _snap()[0] == n1
+
+
+# ------------------------------------------------- on-disk containers
+
+@pytest.fixture(scope="module")
+def comp_index(toy_graph, dc1, tmp_path_factory):
+    """A pack4-compressed on-disk index (pack4 is always viable on the
+    toy shard; rle legitimately degrades at this tiny scale)."""
+    d = str(tmp_path_factory.mktemp("comp-disk"))
+    build_worker_shard(toy_graph, dc1, 0, d, chunk=16, codec="pack4")
+    write_index_manifest(d, dc1)
+    return d
+
+
+def test_compressed_index_manifest_and_bytes(toy_graph, dc1, shard_dir,
+                                             comp_index):
+    man = read_manifest(comp_index)
+    assert all(m.get("codec") == "pack4"
+               for m in man["blocks"].values())
+    for f in man["files"]:
+        raw = np.load(os.path.join(shard_dir, f))
+        comp = np.load(os.path.join(comp_index, f))
+        assert resident.is_container(comp)
+        assert comp.nbytes < raw.nbytes
+        np.testing.assert_array_equal(
+            resident.decode_block_rows(comp), raw)
+
+
+def test_verify_checks_compressed_blocks(toy_graph, dc1, comp_index):
+    rep = verify_index(comp_index, dc1)
+    assert verify_exit_code(rep) == 0 and rep["ok"] == rep["total"]
+    # a codec/manifest mismatch is corrupt even when the digest is
+    # refreshed to match: swap a raw payload in and re-digest
+    man = read_manifest(comp_index)
+    f0 = man["files"][0]
+    from distributed_oracle_search_tpu.models.cpd import check_block
+
+    status, reason = check_block(
+        os.path.join(comp_index, f0), {"codec": "rle"})
+    assert status == "corrupt" and "codec" in reason
+
+
+def test_compressed_index_serves_and_heals(monkeypatch, toy_graph, dc1,
+                                           shard_dir, comp_index,
+                                           walk_queries, baseline,
+                                           tmp_path):
+    """Engine + oracle load the compressed index transparently; a torn
+    container is quarantined and healed back COMPRESSED (the manifest
+    owns the block's codec, not the process env)."""
+    monkeypatch.delenv("DOS_CPD_RESIDENT", raising=False)
+    eng = ShardEngine(toy_graph, dc1, 0, comp_index)
+    rc = RuntimeConfig()
+    for a, b in zip(baseline[0], eng.answer(walk_queries, rc)[:3]):
+        np.testing.assert_array_equal(a, b)
+    CPDOracle(toy_graph, dc1).load(comp_index)
+    # tear one container mid-payload
+    man = read_manifest(comp_index)
+    f0 = man["files"][0]
+    p0 = os.path.join(comp_index, f0)
+    data = open(p0, "rb").read()
+    with open(p0, "wb") as f:
+        f.write(data[:len(data) // 2])
+    assert verify_exit_code(verify_index(comp_index, dc1)) == 3
+    before = _counter("cpd_blocks_rebuilt_total")
+    rows = load_shard_rows(comp_index, 0, dc=dc1, graph=toy_graph)
+    assert _counter("cpd_blocks_rebuilt_total") == before + 1
+    np.testing.assert_array_equal(
+        rows, load_shard_rows(shard_dir, 0))
+    assert verify_exit_code(verify_index(comp_index, dc1)) == 0
+    assert resident.is_container(np.load(p0))
+    assert read_manifest(comp_index)["blocks"][f0].get(
+        "codec") == "pack4"
+
+
+def test_replica_copy_ships_container(toy_graph, comp_index):
+    """copy_replica_blocks moves the compressed container verbatim —
+    the smaller anti-entropy/catch-up payload the membership plane
+    wants — and journals its codec."""
+    from distributed_oracle_search_tpu.models.cpd import (
+        BuildLedger, copy_replica_blocks, shard_block_name,
+    )
+
+    dcr = DistributionController("tpu", None, 1, toy_graph.n,
+                                 replication=1)
+    copy_replica_blocks(dcr, 0, 1, comp_index)
+    prim = np.load(os.path.join(comp_index, shard_block_name(0, 0)))
+    rep = np.load(os.path.join(comp_index,
+                               shard_block_name(0, 0, 1)))
+    np.testing.assert_array_equal(np.asarray(prim), np.asarray(rep))
+    assert resident.is_container(rep)
+    ent = BuildLedger(comp_index, 0, 1).entries()[
+        shard_block_name(0, 0, 1)]
+    assert ent.get("codec") == "pack4"
+
+
+def test_encode_block_auto_picks_smaller():
+    """On-disk `auto` applies the SAME pick-smaller rule as
+    make_resident: short-run tables where pack4 beats rle must not
+    persist the larger rle payload (review regression)."""
+    rng = np.random.default_rng(7)
+    base = rng.integers(-1, 6, size=(1, 64), dtype=np.int64)
+    fm = np.repeat(base, 1200, axis=0).astype(np.int8)
+    flip = rng.random(fm.shape) < 0.12          # run length ~4-5
+    fm[flip] = rng.integers(-1, 6, size=int(flip.sum()))
+    rle_enc = resident.encode_rle(fm)
+    p4 = resident.encode_pack4(fm)
+    assert rle_enc is not None and p4 is not None
+    assert sum(a.nbytes for a in rle_enc[:3]) > p4.nbytes
+    payload, used = resident.encode_block(fm, "auto")
+    assert used == "pack4"
+    _, resident_used = resident.make_resident(fm, codec="auto")
+    assert resident_used == used
+
+
+def test_streamed_decoded_cache_is_bounded(toy_graph, tmp_path):
+    """Decoded compressed blocks live in a small LRU, not the
+    unbounded handle cache — streamed serving of a compressed index
+    must keep its bounded-working-set contract (review regression)."""
+    from distributed_oracle_search_tpu.models.streamed import (
+        StreamedCPDOracle,
+    )
+
+    dcs = DistributionController("tpu", None, 1, toy_graph.n,
+                                 block_size=8)
+    d = str(tmp_path / "sm")
+    build_worker_shard(toy_graph, dcs, 0, d, chunk=8, codec="pack4")
+    write_index_manifest(d, dcs)
+    st = StreamedCPDOracle(toy_graph, dcs, d, row_chunk=8,
+                           cache_bytes=0)
+    n_blocks = -(-dcs.n_owned(0) // dcs.block_size)
+    assert n_blocks > st._DECODED_KEEP          # the bound can bite
+    for bid in range(n_blocks):
+        blk = st._block(0, bid)
+        assert blk.dtype == np.int8 and blk.ndim == 2
+    assert len(st._decoded) == st._DECODED_KEEP
+    # recency refresh: a cached block re-touched stays resident
+    st._block(0, n_blocks - 1)
+    assert (0, n_blocks - 1) in st._decoded
+
+
+def test_replica_recompute_keeps_primary_codec(toy_graph, tmp_path):
+    """A replica recomputed from the graph (primary unreachable —
+    separate filesystems) uses the PRIMARY's codec, so its digest can
+    converge with the anti-entropy cross-check (review regression)."""
+    from distributed_oracle_search_tpu.models.cpd import (
+        _primary_codec, build_replica_shards, shard_block_name,
+    )
+
+    dcr = DistributionController("tpu", None, 2, toy_graph.n,
+                                 replication=2)
+    d = str(tmp_path / "repl")
+    for wid in range(2):
+        build_worker_shard(toy_graph, dcr, wid, d, chunk=16,
+                           codec="pack4")
+    assert _primary_codec(d, 0) == "pack4"
+    # make shard 0's primary unreachable (its ledger survives: that is
+    # what records the codec a recompute must match)
+    for p in glob.glob(os.path.join(d, "cpd-w00000-b*.npy")):
+        os.remove(p)
+    build_replica_shards(toy_graph, dcr, 1, d, chunk=16)
+    rep = np.load(os.path.join(d, shard_block_name(0, 0, 1)))
+    assert resident.is_container(rep)
+    assert resident.block_codec(rep) == "pack4"
+
+
+def test_streamed_oracle_reads_compressed_blocks(toy_graph, dc1,
+                                                 comp_index,
+                                                 toy_queries):
+    """The streamed serving path decodes container blocks on first
+    touch — answers identical to the resident oracle's."""
+    from distributed_oracle_search_tpu.models.streamed import (
+        StreamedCPDOracle,
+    )
+
+    st = StreamedCPDOracle(toy_graph, dc1, comp_index, row_chunk=16,
+                           cache_bytes=0)
+    c, p, f = st.query(np.asarray(toy_queries, np.int64))
+    oracle = CPDOracle(toy_graph, dc1).load(comp_index)
+    c2, p2, f2 = oracle.query(np.asarray(toy_queries, np.int64))
+    np.testing.assert_array_equal(c, c2)
+    np.testing.assert_array_equal(p, p2)
+    np.testing.assert_array_equal(f, f2)
+
+
+# -------------------------------------------------- delta on compressed
+
+@pytest.fixture(scope="module")
+def delta_city(tmp_path_factory):
+    """A 432-node city with a pack4-compressed index and a corner
+    hotspot diff whose dirty cone leaves most rows clean."""
+    from distributed_oracle_search_tpu.data import synth_city_graph
+
+    g = synth_city_graph(24, 18, seed=3)
+    dc = DistributionController("div", g.n, 1, g.n, block_size=64)
+    d = str(tmp_path_factory.mktemp("comp-delta"))
+    build_worker_shard(g, dc, 0, d, chunk=64, codec="pack4")
+    write_index_manifest(d, dc)
+    return g, dc, d
+
+
+def test_delta_empty_copies_containers(delta_city):
+    """An empty delta byte-copies every compressed block verbatim into
+    the epoch index (codec journaled, digests cross-checked)."""
+    g, dc, d = delta_city
+    fused = os.path.join(d, "fused-e000001.diff")
+    eid = np.array([0])
+    write_diff(fused, g.src[eid], g.dst[eid],
+               g.w[eid].astype(np.int64))      # same weight: no change
+    rep = delta_build_index(g, dc, d, fused)
+    assert rep["blocks_skipped"] == 7 and rep["rows_recomputed"] == 0
+    man = read_manifest(d)
+    for f in man["files"]:
+        np.testing.assert_array_equal(
+            np.load(os.path.join(rep["outdir"], f)),
+            np.load(os.path.join(d, f)))
+    eman = read_manifest(rep["outdir"])
+    assert all(m.get("codec") == "pack4"
+               for m in eman["blocks"].values())
+
+
+def test_delta_splice_on_compressed_index(delta_city, tmp_path):
+    """A real retime splices through decode -> row splice ->
+    re-encode: the epoch index stays compressed and decodes
+    bit-identical to a from-scratch RAW build on the retimed graph."""
+    g, dc, d = delta_city
+    cand = np.nonzero((g.src > g.n - 30) & (g.dst > g.n - 30))[0][:1]
+    fused = os.path.join(d, "fused-e000002.diff")
+    write_diff(fused, g.src[cand], g.dst[cand],
+               g.w[cand].astype(np.int64) * 3)
+    rep = delta_build_index(g, dc, d, fused)
+    assert not rep["degraded_full"]
+    assert 0 < rep["rows_recomputed"] < g.n
+    g2 = Graph(g.xs, g.ys, g.src, g.dst, g.weights_with_diff(fused))
+    full = str(tmp_path / "full")
+    build_worker_shard(g2, dc, 0, full, chunk=64)           # raw
+    for f in read_manifest(d)["files"]:
+        ed = np.load(os.path.join(rep["outdir"], f))
+        assert resident.is_container(ed), f
+        np.testing.assert_array_equal(
+            resident.decode_block_rows(ed),
+            np.load(os.path.join(full, f)))
+    assert verify_exit_code(verify_index(rep["outdir"])) == 0
+
+
+# ------------------------------------------------------ debris sweep
+
+def test_sweep_covers_compressed_debris(tmp_path):
+    """Tmp debris of compressed block writes (and persisted rle
+    sidecars) matches the existing stale-artifact sweep patterns."""
+    d = str(tmp_path)
+    old = time.time() - 120
+    debris = [
+        os.path.join(d, "cpd-w00000-b00003.npy.tmp.1234"),
+        os.path.join(d, "rle-w00000-r000000000-c512.npz.77.tmp.npz"),
+    ]
+    keep = os.path.join(d, "cpd-w00000-b00003.npy")
+    for p in debris + [keep]:
+        with open(p, "wb") as f:
+            f.write(b"x")
+        os.utime(p, (old, old))
+    swept = sweep_stale_artifacts(d)
+    assert swept == 2
+    assert not any(os.path.exists(p) for p in debris)
+    assert os.path.exists(keep)
+
+
+# ---------------------------------------------------- gates & registry
+
+def test_bench_diff_compressed_directions():
+    """The compressed key family's directions are explicit, pinned."""
+    for key in ("cpd_resident_bytes_ratio",
+                "compressed_walk_queries_per_sec",
+                "compressed_raw_walk_queries_per_sec",
+                "compressed_vs_raw_walk_ratio"):
+        assert fleet._KEY_DIRECTIONS[key] == "higher", key
+    assert fleet._KEY_DIRECTIONS[
+        "compressed_decompress_seconds"] == "lower"
+    assert fleet._KEY_TOLERANCES["cpd_resident_bytes_ratio"] == 0.15
+
+
+def test_bench_diff_gates_compressed_regression(tmp_path):
+    """End-to-end through compare_bench: a ratio drop and a decompress
+    blow-up both gate."""
+    def _rec(name, headline):
+        p = tmp_path / name
+        p.write_text(json.dumps(
+            {"parsed": {"metric": "m", "value": 1.0,
+                        "headline": headline}}))
+        return str(p)
+
+    old = _rec("BENCH_r01.json",
+               {"cpd_resident_bytes_ratio": 8.0,
+                "compressed_decompress_seconds": 0.01})
+    new = _rec("BENCH_r02.json",
+               {"cpd_resident_bytes_ratio": 4.0,
+                "compressed_decompress_seconds": 0.05})
+    out = fleet.compare_bench(old, new)
+    bad = {e["key"] for e in out["regressions"]}
+    assert bad == {"cpd_resident_bytes_ratio",
+                   "compressed_decompress_seconds"}
+
+
+def test_metrics_registered_in_obs_map():
+    """New series documented in the obs/__init__ metric map (the
+    dos-lint metric-registry contract)."""
+    import distributed_oracle_search_tpu.obs as obs
+
+    for name in ("cpd_resident_bytes", "cpd_resident_degraded_total",
+                 "cpd_decompress_seconds",
+                 "walk_compressed_batches_total"):
+        assert name in obs.__doc__, name
+
+
+def test_statusz_reports_resident(monkeypatch, toy_graph, dc1,
+                                  shard_dir):
+    """The worker statusz payload carries the resident codec + bytes
+    (engine-side attributes the server copies)."""
+    eng = _codec_engine(monkeypatch, "rle", toy_graph, dc1, 0,
+                        shard_dir)
+    assert eng.resident_codec == "rle"
+    assert eng.resident_bytes == eng.fm.nbytes
+
+
+def test_stale_crossref_fixed():
+    """Satellite pin: ops/pallas_walk.py no longer points the loader
+    seam at the pre-re-anchor 'ROADMAP item 3'."""
+    import distributed_oracle_search_tpu.ops.pallas_walk as pw
+
+    src = open(pw.__file__.rstrip("c")).read()
+    assert "ROADMAP item 3" not in src
+    assert "ROADMAP item 1" in src
