@@ -2110,6 +2110,142 @@ def main() -> None:
             f"mean batch fill {mean_fill:.1f}, "
             f"shed {serve_stats['serve_shed']}")
 
+    # ---- transport section: the streaming RPC data plane vs the FIFO
+    # wire, head to head on the SAME worker, engine, and workload —
+    # per-batch dispatch overhead (wall minus pure engine time), p99,
+    # and throughput for each lane. One in-thread FifoServer serves
+    # both transports (the FIFO loop and the socket accept loop share
+    # the engine), so the delta is pure transport cost: query-file
+    # write + bash transfer script + two FIFO rendezvous + results
+    # sidecar read vs one frame round-trip. BENCH_RPC=0 skips.
+    rpc_stats = {}
+    if os.environ.get("BENCH_RPC", "1") != "0":
+        import threading as _threading
+
+        import distributed_oracle_search_tpu.serving.dispatch as _dmod
+        from distributed_oracle_search_tpu.data import (
+            ensure_synth_dataset, read_scen,
+        )
+        from distributed_oracle_search_tpu.data.graph import Graph
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            FifoDispatcher, RpcDispatcher,
+        )
+        from distributed_oracle_search_tpu.transport.wire import (
+            RuntimeConfig,
+        )
+        from distributed_oracle_search_tpu.utils.config import (
+            ClusterConfig,
+        )
+        from distributed_oracle_search_tpu.worker import (
+            FifoServer, stop_server,
+        )
+        from distributed_oracle_search_tpu.worker.server import (
+            RpcServeLoop,
+        )
+
+        log("transport (rpc vs fifo dispatch, one worker, same "
+            "workload)...")
+        tdir = tempfile.mkdtemp(prefix="bench-rpc-")
+        _old_sockdir = os.environ.get("DOS_RPC_SOCKET_DIR")
+        os.environ["DOS_RPC_SOCKET_DIR"] = tdir
+        tpaths = ensure_synth_dataset(tdir, width=24, height=18,
+                                      n_queries=512, seed=37)
+        tconf = ClusterConfig(
+            workers=["localhost"], partmethod="mod", partkey=1,
+            outdir=os.path.join(tdir, "index"), xy_file=tpaths["xy"],
+            scenfile=tpaths["scen"], nfs=tdir).validate()
+        tg = Graph.from_xy(tconf.xy_file)
+        tdc = DistributionController("mod", 1, 1, tg.n)
+        build_worker_shard(tg, tdc, 0, tconf.outdir)
+        write_index_manifest(tconf.outdir, tdc)
+        tqueries = read_scen(tconf.scenfile)
+        tfifo = os.path.join(tdir, "worker0.fifo")
+        tsrv = FifoServer(tconf, 0, command_fifo=tfifo)
+        tth = _threading.Thread(target=tsrv.serve_forever, daemon=True)
+        tth.start()
+        for _ in range(200):
+            if os.path.exists(tfifo):
+                break
+            time.sleep(0.02)
+        tloop = RpcServeLoop(tsrv).start()
+        nb = int(os.environ.get("BENCH_RPC_BATCHES", 48))
+        bsz = int(os.environ.get("BENCH_RPC_BATCH", 64))
+        tbatches = [tqueries[(i * bsz) % len(tqueries):][:bsz]
+                    for i in range(nb)]
+        tbatches = [b if len(b) == bsz else tqueries[:bsz]
+                    for b in tbatches]
+        trc = RuntimeConfig()
+        fifo_disp = FifoDispatcher(tconf, timeout=120.0)
+        rpc_disp = RpcDispatcher(tconf, timeout=120.0)
+        orig_cfp = _dmod.command_fifo_path
+        _dmod.command_fifo_path = lambda wid: tfifo
+        try:
+            # warm every lane + the engine's compiled programs off the
+            # clock (a mid-run XLA compile would charge one transport)
+            fifo_disp.answer_batch(0, tbatches[0], trc, "-")
+            rpc_disp.answer_batch(0, tbatches[0], trc, "-")
+            tsrv.engine.answer(tbatches[0], trc, "-")
+
+            def _drive(step):
+                lat = []
+                t0 = time.perf_counter()
+                for b in tbatches:
+                    s = time.perf_counter()
+                    step(b)
+                    lat.append(time.perf_counter() - s)
+                return time.perf_counter() - t0, np.array(lat)
+
+            eng_wall, eng_lat = _drive(
+                lambda b: tsrv.engine.answer(b, trc, "-"))
+            rpc_wall, rpc_lat = _drive(
+                lambda b: rpc_disp.answer_batch(0, b, trc, "-"))
+            fifo_wall, fifo_lat = _drive(
+                lambda b: fifo_disp.answer_batch(0, b, trc, "-"))
+        finally:
+            _dmod.command_fifo_path = orig_cfp
+            rpc_disp.close()
+            fifo_disp.close()
+            stop_server(tfifo, deadline_s=5.0)
+            tth.join(timeout=15)
+            tloop.stop()
+            shutil.rmtree(tdir, ignore_errors=True)
+            # restore the socket-dir knob: a later section's supervisor
+            # must not resolve sockets under the deleted temp dir
+            if _old_sockdir is None:
+                os.environ.pop("DOS_RPC_SOCKET_DIR", None)
+            else:
+                os.environ["DOS_RPC_SOCKET_DIR"] = _old_sockdir
+        eng_ms = float(eng_lat.mean() * 1e3)
+        rpc_over = float(max(rpc_lat.mean() * 1e3 - eng_ms, 1e-3))
+        fifo_over = float(max(fifo_lat.mean() * 1e3 - eng_ms, 1e-3))
+        rpc_stats = {
+            # per-batch dispatch OVERHEAD: mean wall minus the pure
+            # engine time for the identical batch sequence
+            "serve_rpc_dispatch_ms": round(rpc_over, 3),
+            "serve_fifo_dispatch_ms": round(fifo_over, 3),
+            "serve_rpc_vs_fifo_dispatch_ratio": round(
+                fifo_over / rpc_over, 2),
+            "serve_rpc_p99_ms": round(
+                float(np.percentile(rpc_lat, 99)) * 1e3, 3),
+            "serve_fifo_p99_ms": round(
+                float(np.percentile(fifo_lat, 99)) * 1e3, 3),
+            "serve_rpc_queries_per_sec": round(
+                nb * bsz / rpc_wall, 1),
+            "serve_fifo_queries_per_sec": round(
+                nb * bsz / fifo_wall, 1),
+        }
+        log(f"transport: engine {eng_ms:.2f} ms/batch; rpc overhead "
+            f"{rpc_over:.2f} ms/batch "
+            f"(p99 {rpc_stats['serve_rpc_p99_ms']:.1f} ms), fifo "
+            f"overhead {fifo_over:.2f} ms/batch "
+            f"(p99 {rpc_stats['serve_fifo_p99_ms']:.1f} ms) -> "
+            f"ratio {rpc_stats['serve_rpc_vs_fifo_dispatch_ratio']}x, "
+            f"{rpc_stats['serve_rpc_queries_per_sec']:,.0f} vs "
+            f"{rpc_stats['serve_fifo_queries_per_sec']:,.0f} q/s")
+
     # ---- replication section: failover throughput/latency with a
     # killed primary, and hedge win rate under an injected delay fault.
     # A small dedicated 2-worker R=2 host-style world (block files +
@@ -2588,6 +2724,7 @@ def main() -> None:
         **mesh_stats,
         **multichip_stats,
         **serve_stats,
+        **rpc_stats,
         **repl_stats,
         **reshard_stats,
         **traffic_stats,
@@ -2643,6 +2780,9 @@ def main() -> None:
         "mesh_mat_rows_per_sec_d8", "multichip_smoke_ok",
         "serve_queries_per_sec", "serve_p99_ms",
         "serve_cache_hit_rate", "serve_mean_batch_fill",
+        "serve_rpc_vs_fifo_dispatch_ratio", "serve_rpc_dispatch_ms",
+        "serve_fifo_dispatch_ms", "serve_rpc_p99_ms",
+        "serve_fifo_p99_ms",
         "traffic_live_swap_queries_per_sec", "traffic_swap_stall_p99_ms",
         "traffic_scoped_hit_rate",
         "devices", "platform",

@@ -674,7 +674,15 @@ class LockScope(Rule):
 class FifoHygiene(Rule):
     name = "fifo-hygiene"
     description = ("FIFO opens use the bounded non-blocking pattern "
-                   "(os.open + O_NONBLOCK/O_RDWR + deadline)")
+                   "(os.open + O_NONBLOCK/O_RDWR + deadline); bare "
+                   "socket recv/sendall live only in transport/frames "
+                   "readers/writers")
+
+    #: the socket half's one sanctioned home: FrameReader/FrameWriter
+    #: own every recv/sendall so torn frames surface as typed,
+    #: retryable TransportErrors instead of ad-hoc partial reads
+    SOCKET_ALLOWED = ("transport/frames.py",)
+    _SOCKET_CALLS = ("recv", "recv_into", "sendall")
 
     def _mentions_fifo(self, node) -> bool:
         for n in ast.walk(node):
@@ -716,3 +724,18 @@ class FifoHygiene(Rule):
                         "peer appears — a crashed peer wedges this "
                         "process forever (bound it: O_NONBLOCK + "
                         "deadline retry)")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in self._SOCKET_CALLS \
+                    and not ctx.relpath.endswith(self.SOCKET_ALLOWED):
+                # the socket half of the rule: wire reads/writes go
+                # through the frame codec's readers/writers, nowhere
+                # else — a bare recv can return a partial frame that
+                # desyncs the stream, and a bare sendall outside the
+                # writer lock can interleave mid-frame
+                yield self.finding(
+                    node,
+                    f"bare socket .{node.func.attr}() outside "
+                    f"transport/frames.py: partial reads/interleaved "
+                    f"writes tear the frame stream — go through "
+                    f"FrameReader/FrameWriter (typed retryable "
+                    f"TransportError on every failure mode)")

@@ -47,6 +47,7 @@ from ..parallel import membership as fleet
 from ..parallel.multihost import is_primary
 from ..transport import fifo as fifo_transport
 from ..transport import resilience
+from ..transport import rpc as rpc_transport
 from ..utils.atomicio import atomic_write_json, atomic_writer, sweep_stale_artifacts
 from ..utils.config import ClusterConfig, test_config
 from ..utils.env import env_cast, env_flag
@@ -459,6 +460,14 @@ def run_tpu(conf: ClusterConfig, args, queries, dc, diffs):
 
 # ----------------------------------------------------------------- host path
 
+#: DOS_TRANSPORT=auto lanes that proved to have no RPC listener —
+#: sticky for the process (the serving AutoDispatcher contract): a
+#: pure-FIFO fleet pays ONE failed dial + ONE warning per lane, not a
+#: connect attempt per batch. GIL-atomic set mutations; a worker that
+#: GAINS a listener mid-campaign is picked up on the next process.
+_RPC_FALLBACK_LANES: set = set()
+
+
 def send_queries(host: str, wid: int, part: np.ndarray, rconf: RuntimeConfig,
                  nfs: str, diff: str, t_partition: float = 0.0,
                  timeout: float | None = fifo_transport.DEFAULT_TIMEOUT,
@@ -506,16 +515,49 @@ def send_queries(host: str, wid: int, part: np.ndarray, rconf: RuntimeConfig,
         # (transport.fifo.clean_stale_epoch_files)
         epoch = getattr(rconf, "epoch", 0)
         suffix = "" if c_wid == wid else f".s{wid}.e{epoch}"
+        qfile = os.path.join(nfs, f"query.{c_host}{c_wid}{suffix}")
+        rc = (dataclasses.replace(rconf, trace_id=trace_id)
+              if trace_id else rconf)
+        # streaming lane (DOS_TRANSPORT=rpc/auto): the batch rides a
+        # persistent socket as a raw int64 frame segment — no query
+        # file, no transfer script, no FIFO rendezvous. Paths/trace
+        # payloads still materialize as the legacy sidecars NEXT TO
+        # the (never-written) query-file name, so the extraction and
+        # trace collectors read them unchanged. `auto` falls through
+        # to the FIFO wire when this worker has no listener — STICKY
+        # per (host, wid) like the serving AutoDispatcher, so a
+        # pure-FIFO fleet pays one failed dial per lane, not per batch.
+        mode = rpc_transport.resolve_transport()
+        if mode in ("rpc", "auto") and (
+                mode == "rpc"
+                or (c_host, c_wid) not in _RPC_FALLBACK_LANES):
+            try:
+                with Timer() as send, obs_trace.span(
+                        "head.send", wid=c_wid, shard=wid, diff=diff,
+                        trace_id=trace_id):
+                    row = rpc_transport.send_batch_with_retry(
+                        c_host, c_wid, part, rc, diff, timeout=timeout,
+                        policy=policy, sidecar_base=qfile)
+                H_SEND.observe(send.interval)
+                last_qfile[0] = qfile
+                return row
+            except rpc_transport.RpcUnavailable as e:
+                if mode == "rpc":
+                    log.error("worker %d on %s has no rpc listener "
+                              "(DOS_TRANSPORT=rpc): %s", c_wid, c_host,
+                              e)
+                    return StatsRow.failed()
+                _RPC_FALLBACK_LANES.add((c_host, c_wid))
+                log.warning("worker %d on %s has no rpc listener; "
+                            "lane falls back to the FIFO wire",
+                            c_wid, c_host)
         with Timer() as prep, obs_trace.span("head.prepare", wid=c_wid,
                                              shard=wid,
                                              trace_id=trace_id):
-            qfile = os.path.join(nfs, f"query.{c_host}{c_wid}{suffix}")
             write_query_file(qfile, part)
         H_PREPARE.observe(prep.interval)
         prep_total[0] += prep.interval
         last_qfile[0] = qfile
-        rc = (dataclasses.replace(rconf, trace_id=trace_id)
-              if trace_id else rconf)
         req = Request(rc, qfile,
                       answer_fifo_path(nfs, c_host, c_wid) + suffix,
                       diff)
@@ -581,6 +623,12 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
     rconf = runtime_config(args)
     groups = dc.group_queries(queries, active_worker=args.worker)
     timeout = send_timeout_s(args)
+    transport_mode = rpc_transport.resolve_transport()
+    if transport_mode != "fifo":
+        log.info("campaign data plane: DOS_TRANSPORT=%s (persistent "
+                 "sockets%s)", transport_mode,
+                 "; per-lane FIFO fallback"
+                 if transport_mode == "auto" else "")
     # fault-tolerance plumbing: stale FIFOs from crashed runs are swept
     # before the first batch (a killed transfer script never reaches its
     # `rm -f`), stale build artifacts (*.tmp debris, quarantined blocks)
@@ -610,6 +658,9 @@ def run_host(conf: ClusterConfig, args, queries, dc, diffs,
             tracing, base_tid, policy, registry, mstate=mstate)
     finally:
         registry.shutdown()
+        # persistent RPC connections live for the whole campaign; drop
+        # them with it (harmless no-op on the pure-FIFO lane)
+        rpc_transport.close_clients()
     if failures:
         log.error("campaign degraded: %d failed batch(es) across "
                   "workers %s", len(failures),

@@ -34,11 +34,13 @@ import threading
 
 from ..obs import metrics as obs_metrics
 from ..serving import (
-    EngineDispatcher, FifoDispatcher, ServeConfig, ServingFrontend,
+    AutoDispatcher, EngineDispatcher, FifoDispatcher, RpcDispatcher,
+    ServeConfig, ServingFrontend,
 )
 from ..serving import ingress
 from ..transport import fifo as fifo_transport
 from ..transport import resilience
+from ..transport import rpc as rpc_transport
 from ..transport.fifo import command_fifo_path
 from ..transport.wire import RuntimeConfig
 from ..utils.config import ClusterConfig, test_config
@@ -121,11 +123,35 @@ def build_frontend(conf: ClusterConfig, args):
             raise SystemExit(
                 "--backend host needs host-mode workers; partmethod=tpu "
                 "shards live on the device mesh (use --backend inproc)")
-        dispatcher = FifoDispatcher(conf)
-        registry = resilience.BreakerRegistry(
-            probe_fn=lambda key: fifo_transport.probe(
+        # DOS_TRANSPORT selects the host-backend data plane: `fifo`
+        # (default — the campaign wire, byte-identical legacy), `rpc`
+        # (persistent multiplexed sockets, no per-batch files), `auto`
+        # (rpc with sticky per-lane fifo fallback for mixed fleets)
+        transport = rpc_transport.resolve_transport()
+        if transport == "rpc":
+            dispatcher = RpcDispatcher(conf)
+            probe_fn = lambda key: rpc_transport.probe(  # noqa: E731
+                key[1], host=key[0])
+        elif transport == "auto":
+            dispatcher = AutoDispatcher(conf)
+
+            def probe_fn(key):
+                st = rpc_transport.probe(key[1], host=key[0])
+                if st is not None:
+                    return st
+                return fifo_transport.probe(
+                    key[0], key[1],
+                    command_fifo=command_fifo_path(key[1]),
+                    nfs=conf.nfs)
+        else:
+            dispatcher = FifoDispatcher(conf)
+            probe_fn = lambda key: fifo_transport.probe(  # noqa: E731
                 key[0], key[1], command_fifo=command_fifo_path(key[1]),
-                nfs=conf.nfs))
+                nfs=conf.nfs)
+        if transport != "fifo":
+            log.info("host backend data plane: DOS_TRANSPORT=%s",
+                     transport)
+        registry = resilience.BreakerRegistry(probe_fn=probe_fn)
         breaker_key = lambda wid: (conf.workers[wid], wid)  # noqa: E731
     else:
         dispatcher = EngineDispatcher(conf, alg=args.alg,

@@ -246,6 +246,38 @@ lane mesh, ``DOS_MESH_DEVICES``; README "Worker mesh"):
   row (``CPDOracle.query_mat``: walk + scatter + psum, replacing the
   head-side fan-out/join).
 
+Streaming RPC data plane (``transport.frames``/``transport.rpc`` +
+the worker's socket accept loop — persistent multiplexed connections
+replacing per-batch files and FIFO round-trips, ``DOS_TRANSPORT``;
+README "Streaming data plane"):
+
+* frame codec — ``rpc_frames_sent_total`` / ``rpc_frames_received_total``
+  (every frame on every socket, both directions),
+  ``rpc_frames_torn_total`` (frames that died mid-read: peer gone,
+  reset, bad magic — each surfaced as a retryable TransportError);
+* client connections — ``rpc_connects_total`` /
+  ``rpc_reconnects_total`` (persistent connections established /
+  re-established after a failure), ``rpc_transport_errors_total``
+  (calls failed by transport faults, the breaker/failover feed),
+  ``rpc_heartbeats_total`` (pings riding the HealthStatus vocabulary
+  over live connections, ``DOS_RPC_HEARTBEAT_S``);
+* backpressure — ``rpc_busy_frames_total`` (explicit BUSY credit-
+  window refusals, client and server sides both book here — the
+  timeout-discovery replacement);
+* dispatch — ``rpc_dispatch_seconds`` (one serving batch over the
+  socket transport, send to decoded reply);
+* worker accept loop — ``rpc_server_connections`` (gauge: live client
+  connections), ``rpc_server_batches_total`` (batches answered over
+  sockets — the RPC twin of ``server_replies_sent_total``),
+  ``rpc_server_replies_dropped_total`` (drop-reply fault or the
+  client vanished), ``rpc_server_frames_malformed_total``
+  (undecodable request configs answered FAIL — the socket twin of
+  ``server_frames_malformed_total``);
+* hedged FIFO dispatch (the compat backend's satellite fix) —
+  ``serve_hedge_qfile_reused_total`` (hedge duplicates that reused
+  the primary attempt's already-written query file instead of paying
+  a second filesystem round-trip per candidate).
+
 Compressed residency (``models.resident`` — RLE/pack4 CPD shards kept
 compressed in device memory and decompressed only at the point of use,
 ``DOS_CPD_RESIDENT``; README "Compressed residency"):

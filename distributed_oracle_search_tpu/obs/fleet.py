@@ -242,6 +242,33 @@ def _summarize(status: dict) -> dict:
                 and not isinstance(mesh.get("devices"), bool)):
             out["mesh"] = int(mesh["devices"])
             break
+    # streaming-transport columns (the RPC data plane): connections,
+    # in-flight frames, credit window — a worker row reads its accept
+    # loop, a head row folds its per-worker client table. Pre-RPC
+    # endpoints omit the section and their rows show "-" blanks, never
+    # a crash (the same mixed-schema tolerance as every other column)
+    for sec in (serving, worker):
+        tr = sec.get("transport")
+        if not isinstance(tr, dict) or not tr:
+            continue
+        conns = tr.get("connections")
+        if isinstance(conns, dict):
+            # head side (RpcDispatcher/AutoDispatcher): one entry per
+            # worker connection
+            out["conns"] = len(conns)
+            out["inflight"] = sum(
+                _num(c.get("inflight")) for c in conns.values()
+                if isinstance(c, dict))
+        elif isinstance(conns, (int, float)) \
+                and not isinstance(conns, bool):
+            # worker side (RpcServeLoop.statusz)
+            out["conns"] = int(conns)
+            out["inflight"] = _num(tr.get("inflight"))
+        credit = tr.get("credit")
+        if isinstance(credit, (int, float)) \
+                and not isinstance(credit, bool):
+            out["credit"] = int(credit)
+        break
     mig = serving.get("migration") or worker.get("migration")
     if isinstance(mig, dict):
         moves = mig.get("moves") if isinstance(mig.get("moves"), list) \
@@ -336,6 +363,18 @@ _KEY_DIRECTIONS = {
     "compressed_raw_walk_queries_per_sec": "higher",
     "compressed_vs_raw_walk_ratio": "higher",
     "compressed_decompress_seconds": "lower",
+    # the streaming-transport family (RPC vs FIFO head-to-head on the
+    # same workload): the dispatch-overhead ratio improves UP (fifo
+    # per-batch cost / rpc per-batch cost), per-batch overheads and
+    # tail latency improve DOWN (the _ms suffix would catch those —
+    # listed so the family's contract is in one place like the others)
+    "serve_rpc_vs_fifo_dispatch_ratio": "higher",
+    "serve_rpc_dispatch_ms": "lower",
+    "serve_fifo_dispatch_ms": "lower",
+    "serve_rpc_p99_ms": "lower",
+    "serve_fifo_p99_ms": "lower",
+    "serve_rpc_queries_per_sec": "higher",
+    "serve_fifo_queries_per_sec": "higher",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -358,6 +397,13 @@ _KEY_TOLERANCES = {
     # on a fixed synthetic graph (bytes in / bytes out), not a timing
     # — a real drop means the encoder stopped compressing
     "cpd_resident_bytes_ratio": 0.15,
+    # the rpc-vs-fifo dispatch ratio measures transport overhead
+    # (subprocess + files + FIFO rendezvous vs one socket round-trip)
+    # on the SAME engine and workload; it sits far above 1 and jitter
+    # affects both lanes alike, but the FIFO lane's bash-subprocess
+    # cost swings with host load — gate it loosely (a real regression
+    # to ~1 still trips)
+    "serve_rpc_vs_fifo_dispatch_ratio": 0.5,
 }
 
 

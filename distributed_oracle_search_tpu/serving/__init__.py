@@ -54,7 +54,8 @@ from .batcher import MicroBatcher
 from .cache import ResultCache, knob_fingerprint
 from .config import ServeConfig
 from .dispatch import (
-    CallableDispatcher, DispatchError, EngineDispatcher, FifoDispatcher,
+    AutoDispatcher, CallableDispatcher, DispatchError, EngineDispatcher,
+    FifoDispatcher, RpcDispatcher, RpcUnavailableError,
 )
 from .frontend import ServingFrontend
 from .hedge import HedgeConfig, HedgeTracker
@@ -65,8 +66,9 @@ from .request import (
 )
 
 __all__ = [
-    "BUSY", "CallableDispatcher", "DispatchError", "ERROR",
-    "EngineDispatcher", "FifoDispatcher", "Future", "HedgeConfig",
+    "AutoDispatcher", "BUSY", "CallableDispatcher", "DispatchError",
+    "ERROR", "EngineDispatcher", "FifoDispatcher", "Future",
+    "HedgeConfig", "RpcDispatcher", "RpcUnavailableError",
     "HedgeTracker", "MicroBatcher", "OK",
     "ResultCache", "ServeConfig", "ServeRequest", "ServeResult",
     "ServingFrontend", "ShardQueue", "TIMEOUT", "UNAVAILABLE",

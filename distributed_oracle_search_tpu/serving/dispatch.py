@@ -19,6 +19,14 @@ record.
   answer FIFOs), and per-query answers read back from the
   ``<queryfile>.results`` sidecar (``RuntimeConfig.results`` wire
   extension).
+* :class:`RpcDispatcher` — the streaming data plane
+  (``DOS_TRANSPORT=rpc``): one persistent multiplexed socket per
+  worker (``transport.rpc``), queries and per-query answers riding as
+  raw ndarray frame segments — no files, no FIFO rendezvous, no
+  text parse on the hot path.
+* :class:`AutoDispatcher` — ``DOS_TRANSPORT=auto``: RPC first, with a
+  sticky per-lane fallback to the FIFO wire when a worker has no RPC
+  listener (mixed fleets mid-rollout).
 * :class:`CallableDispatcher` — adapter for tests and the bench's
   resident-oracle serving mode.
 """
@@ -28,12 +36,17 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import os
+import time
+import zlib
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from ..parallel.partition import DistributionController
 from ..transport import fifo as fifo_transport
+from ..transport import rpc as rpc_transport
 from ..transport.fifo import answer_fifo_path, command_fifo_path
+from ..transport.frames import TransportError
 from ..transport.wire import (
     Request, RuntimeConfig, paths_file_for, read_paths_file,
     read_results_file, results_file_for, write_query_file,
@@ -44,9 +57,25 @@ from ..utils.log import get_logger
 
 log = get_logger(__name__)
 
+M_HEDGE_QFILE_REUSED = obs_metrics.counter(
+    "serve_hedge_qfile_reused_total",
+    "hedged FIFO dispatches that reused the primary attempt's already-"
+    "written query file instead of paying a second filesystem write")
+H_RPC_DISPATCH = obs_metrics.histogram(
+    "rpc_dispatch_seconds",
+    "one serving batch over the socket transport, send to decoded "
+    "reply (the RPC twin of the FIFO lane inside "
+    "serve_dispatch_seconds)")
+
 
 class DispatchError(RuntimeError):
     """A shard batch could not be answered."""
+
+
+class RpcUnavailableError(DispatchError):
+    """The worker has no reachable RPC listener (connect refused /
+    socket absent) — the ``auto`` transport's FIFO-fallback signal, as
+    opposed to a worker that answered and failed."""
 
 
 class EngineDispatcher:
@@ -221,6 +250,20 @@ class FifoDispatcher:
         #: ordering, not latency.
         self._lane_locks: dict[tuple, OrderedLock] = {}
         self._locks_guard = OrderedLock("serving.FifoDispatcher.guard")
+        #: live shared query files keyed by batch content digest: a
+        #: HEDGE duplicate dispatches the same (shard, queries, diff)
+        #: while the primary attempt is still in flight — it reuses the
+        #: primary's already-written query file instead of paying a
+        #: second filesystem round-trip per candidate (ROADMAP item 3
+        #: callout). Entry = ``[qfile, refs, orphaned, qbytes]``:
+        #: refcounted so a LATER identical batch (skewed repeats)
+        #: writes fresh (reuse is scoped to overlapping duplicates);
+        #: ``qbytes`` is compared on every hit so a crc32 collision
+        #: can never alias two different batches onto one file; and
+        #: ``orphaned`` marks a file whose writer lane moved on while
+        #: a reuser was still in flight — the LAST reference unlinks
+        #: it instead of the writer's sweep.
+        self._shared_q: dict[tuple, list] = {}
 
     def _lane_lock(self, lane: tuple) -> OrderedLock:
         with self._locks_guard:
@@ -238,12 +281,20 @@ class FifoDispatcher:
         import stat as _stat
 
         qfile, answer_base = prev
-        for p in (qfile, results_file_for(qfile),
-                  paths_file_for(qfile)):
-            try:
-                os.remove(p)
-            except OSError:
-                pass
+        if qfile:       # a hedge lane that REUSED the primary's query
+            # file books (None, fifos): only the writer lane sweeps it
+            with self._locks_guard:
+                live = next((e for e in self._shared_q.values()
+                             if e[0] == qfile and e[1] > 0), None)
+                if live is not None:
+                    # a hedge duplicate on ANOTHER lane still has this
+                    # file in flight: defer the unlink to the last
+                    # reference's release instead of tearing the
+                    # in-flight attempt's read
+                    live[2] = True
+                    qfile = None
+        if qfile:
+            self._unlink_batch_files(qfile)
         # the per-attempt answer FIFOs (<base>.a<n>) are normally
         # removed by the transfer script's own `rm -f`; a script killed
         # on timeout never gets there, and an orphaned FIFO on the
@@ -252,6 +303,14 @@ class FifoDispatcher:
             try:
                 if _stat.S_ISFIFO(os.stat(p).st_mode):
                     os.remove(p)
+            except OSError:
+                pass
+
+    @staticmethod
+    def _unlink_batch_files(qfile: str) -> None:
+        for p in (qfile, results_file_for(qfile), paths_file_for(qfile)):
+            try:
+                os.remove(p)
             except OSError:
                 pass
 
@@ -297,58 +356,305 @@ class FifoDispatcher:
         host = self.host_of(via)
         nfs = self.conf.nfs
         lane = (wid, via)
+        qbytes = np.ascontiguousarray(queries, np.int64).tobytes()
+        qkey = (wid, len(queries), zlib.crc32(qbytes), diff)
         with self._lane_lock(lane):
             self._sweep_prev(lane)
             tag = f"{os.getpid()}.{next(self._seq)}"
-            qfile = os.path.join(nfs, f"query.serve.{host}{via}.{tag}")
             answer_base = (answer_fifo_path(nfs, host, via)
                            + f".serve.{tag}")
-            self._prev[lane] = (qfile, answer_base)
-            write_query_file(qfile, queries)
+            with self._locks_guard:
+                shared = self._shared_q.get(qkey)
+                # content check, not just the crc key: a 32-bit
+                # collision must degrade to a fresh write, never alias
+                # another batch's queries onto this dispatch
+                if shared is not None and shared[3] == qbytes:
+                    shared[1] += 1
+                else:
+                    shared = None
+            if shared is not None:
+                # a concurrent duplicate of this exact batch (the hedge
+                # lane) — the primary's query file is still live on the
+                # shared dir; reuse it and sweep only our own FIFOs
+                qfile = shared[0]
+                self._prev[lane] = (None, answer_base)
+                M_HEDGE_QFILE_REUSED.inc()
+            else:
+                qfile = os.path.join(nfs,
+                                     f"query.serve.{host}{via}.{tag}")
+                self._prev[lane] = (qfile, answer_base)
+                write_query_file(qfile, queries)
+                with self._locks_guard:
+                    self._shared_q[qkey] = [qfile, 1, False, qbytes]
             req = Request(
                 dataclasses.replace(rconf, results=True), qfile,
                 answer_base, diff)
-            # dos-lint: disable=lock-scope -- holding the lane lock
-            #   across the wire send is the invariant, not an accident:
-            #   the lock exists to serialize same-lane batches so the
-            #   next batch's _sweep_prev can't unlink THIS batch's
-            #   in-flight files; the worker's command FIFO serializes
-            #   same-worker sends anyway, so it adds ordering, not wait
-            row = fifo_transport.send_with_retry(
-                host, req, command_fifo_path(via), timeout=self.timeout,
-                policy=self.policy, wid=via)
-            if not row.ok:
-                detail = (" (STALE_DIFF: worker behind the diff stream)"
-                          if row.stale_diff else
-                          " (STALE_EPOCH: worker behind the partition "
-                          "table)" if row.stale_epoch else "")
-                raise DispatchError(
-                    f"worker {via} on {host} failed a serving batch "
-                    f"({len(queries)} queries for shard {wid})"
-                    + detail)
             try:
-                cost, plen, fin = read_results_file(
-                    results_file_for(qfile))
-            except (OSError, ValueError) as e:
-                # an old server (pre-`results` wire key) answers the
-                # stats line but writes no sidecar — a hard error here,
-                # not a silent all-zeros answer
-                raise DispatchError(
-                    f"worker {via} on {host} returned no results "
-                    f"sidecar (server predates the wire extension?): "
-                    f"{e}") from e
-            if len(cost) != len(queries):
-                raise DispatchError(
-                    f"worker {via} results length {len(cost)} != batch "
-                    f"{len(queries)}")
-            if not want_paths:
-                return cost, plen, fin
-            nodes = moves = None
+                # dos-lint: disable=lock-scope -- holding the lane lock
+                #   across the wire send is the invariant, not an
+                #   accident: the lock exists to serialize same-lane
+                #   batches so the next batch's _sweep_prev can't
+                #   unlink THIS batch's in-flight files; the worker's
+                #   command FIFO serializes same-worker sends anyway,
+                #   so it adds ordering, not wait
+                row = fifo_transport.send_with_retry(
+                    host, req, command_fifo_path(via),
+                    timeout=self.timeout, policy=self.policy, wid=via)
+                if not row.ok:
+                    detail = (
+                        " (STALE_DIFF: worker behind the diff stream)"
+                        if row.stale_diff else
+                        " (STALE_EPOCH: worker behind the partition "
+                        "table)" if row.stale_epoch else "")
+                    raise DispatchError(
+                        f"worker {via} on {host} failed a serving "
+                        f"batch ({len(queries)} queries for shard "
+                        f"{wid})" + detail)
+                try:
+                    cost, plen, fin = read_results_file(
+                        results_file_for(qfile))
+                except (OSError, ValueError) as e:
+                    # an old server (pre-`results` wire key) answers
+                    # the stats line but writes no sidecar — a hard
+                    # error here, not a silent all-zeros answer
+                    raise DispatchError(
+                        f"worker {via} on {host} returned no results "
+                        f"sidecar (server predates the wire "
+                        f"extension?): {e}") from e
+                if len(cost) != len(queries):
+                    raise DispatchError(
+                        f"worker {via} results length {len(cost)} != "
+                        f"batch {len(queries)}")
+                if not want_paths:
+                    return cost, plen, fin
+                nodes = moves = None
+                try:
+                    nodes, moves = read_paths_file(
+                        paths_file_for(qfile))
+                except (OSError, ValueError):
+                    pass   # old server / no extraction: signature-less
+                return cost, plen, fin, nodes, moves
+            finally:
+                # this attempt no longer pins the shared query file; a
+                # LATER identical batch must write its own. The file
+                # itself is swept by the writer lane's next dispatch —
+                # unless that sweep already came and went while a
+                # reuser was in flight (orphaned): then the LAST
+                # reference unlinks it here
+                cleanup = None
+                with self._locks_guard:
+                    ent = self._shared_q.get(qkey)
+                    if ent is not None and ent[0] == qfile:
+                        ent[1] -= 1
+                        if ent[1] <= 0:
+                            self._shared_q.pop(qkey, None)
+                            if ent[2]:
+                                cleanup = ent[0]
+                if cleanup:
+                    self._unlink_batch_files(cleanup)
+
+
+class RpcDispatcher:
+    """The streaming data plane: one persistent, multiplexed socket per
+    worker (``transport.rpc``), frames instead of files.
+
+    Queries ship as a raw int64 payload segment, per-query answers come
+    back as cost/plen/fin segments in the correlated reply frame, and
+    path prefixes (``rconf.sig_k``) ride two more segments — the FIFO
+    lane's query file, ``.results`` sidecar, ``.paths`` sidecar, and
+    both blocking FIFO rendezvous all disappear from the hot path.
+    Transport failures (dead socket, torn frame, timeout) and explicit
+    ``busy`` backpressure frames raise :class:`DispatchError` flavors
+    the frontend already treats as breaker failures + failover; a
+    worker with no listener at all raises
+    :class:`RpcUnavailableError` (the ``auto`` fallback signal)."""
+
+    def __init__(self, conf: ClusterConfig,
+                 timeout: float | None = None, host_of=None):
+        self.conf = conf
+        #: None = defer to DOS_RPC_TIMEOUT_S (resolved inside RpcClient)
+        self.timeout = timeout
+        self.host_of = host_of or (
+            lambda via: self.conf.workers[via % len(self.conf.workers)])
+        self._clients: dict[int, rpc_transport.RpcClient] = {}
+        self._guard = OrderedLock("serving.RpcDispatcher")
+
+    def _client(self, via: int) -> rpc_transport.RpcClient:
+        # the endpoint is re-resolved on EVERY dispatch (the
+        # FifoDispatcher host_of contract): a live-membership host
+        # change retires the stale client and dials the worker's new
+        # home instead of flapping on the dead one forever
+        ep = rpc_transport.endpoint_for(via, host=self.host_of(via))
+        stale = None
+        with self._guard:
+            c = self._clients.get(via)
+            if c is not None and c.endpoint != ep:
+                stale, c = c, None
+            if c is None:
+                c = self._clients[via] = rpc_transport.RpcClient(
+                    ep, timeout_s=self.timeout)
+        if stale is not None:
+            log.info("worker %d rpc endpoint moved %s -> %s; "
+                     "reconnecting", via,
+                     rpc_transport.endpoint_str(stale.endpoint),
+                     rpc_transport.endpoint_str(ep))
+            stale.close(join_s=1.0)
+        return c
+
+    def answer_batch(self, wid: int, queries: np.ndarray,
+                     rconf: RuntimeConfig, diff: str,
+                     via: int | None = None):
+        return self._dispatch(wid, queries, rconf, diff, via,
+                              want_paths=False)
+
+    def answer_batch_paths(self, wid: int, queries: np.ndarray,
+                           rconf: RuntimeConfig, diff: str,
+                           via: int | None = None):
+        return self._dispatch(wid, queries, rconf, diff, via,
+                              want_paths=True)
+
+    def _dispatch(self, wid: int, queries: np.ndarray,
+                  rconf: RuntimeConfig, diff: str,
+                  via: int | None, want_paths: bool):
+        via = wid if via is None else int(via)
+        client = self._client(via)
+        rc = dataclasses.replace(rconf, results=True)
+        q = np.ascontiguousarray(
+            np.asarray(queries, np.int64).reshape(-1, 2))
+        t0 = time.monotonic()
+        try:
+            fr = client.call(
+                rpc_transport.request_header(rc, diff, wid=via), [q])
+        except rpc_transport.RpcUnavailable as e:
+            raise RpcUnavailableError(
+                f"worker {via} has no rpc listener: {e}") from e
+        except rpc_transport.RpcBusy as e:
+            raise DispatchError(
+                f"worker {via} answered BUSY (rpc credit window): {e}"
+            ) from e
+        except TransportError as e:
+            raise DispatchError(
+                f"worker {via} rpc transport failed (retryable): {e}"
+            ) from e
+        H_RPC_DISPATCH.observe(time.monotonic() - t0)
+        row = rpc_transport.decode_reply_row(fr)
+        if not row.ok:
+            detail = (" (STALE_DIFF: worker behind the diff stream)"
+                      if row.stale_diff else
+                      " (STALE_EPOCH: worker behind the partition "
+                      "table)" if row.stale_epoch else "")
+            raise DispatchError(
+                f"worker {via} failed a serving batch over rpc "
+                f"({len(queries)} queries for shard {wid})" + detail)
+        if not fr.header.get("res") or len(fr.arrays) < 3:
+            raise DispatchError(
+                f"worker {via} rpc reply carried no result segments "
+                f"(server predates the wire extension?)")
+        cost = np.asarray(fr.arrays[0], np.int64)
+        plen = np.asarray(fr.arrays[1], np.int64)
+        fin = np.asarray(fr.arrays[2]) != 0
+        if len(cost) != len(queries):
+            raise DispatchError(
+                f"worker {via} rpc results length {len(cost)} != "
+                f"batch {len(queries)}")
+        if not want_paths:
+            return cost, plen, fin
+        nodes = moves = None
+        if fr.header.get("paths") and len(fr.arrays) >= 5:
+            nodes = np.asarray(fr.arrays[3], np.int64)
+            moves = np.asarray(fr.arrays[4], np.int64)
+        return cost, plen, fin, nodes, moves
+
+    def probe(self, via: int):
+        """Breaker-healing hook: the ping/HealthStatus vocabulary over
+        a fresh connection (None on failure, like the FIFO probe)."""
+        return rpc_transport.probe(via, host=self.host_of(via))
+
+    def statusz(self) -> dict:
+        """The ``/statusz`` transport connection table."""
+        with self._guard:
+            return {
+                "mode": "rpc",
+                "connections": {str(via): c.statusz()
+                                for via, c in self._clients.items()},
+            }
+
+    def close(self) -> None:
+        with self._guard:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()
+
+
+class AutoDispatcher:
+    """``DOS_TRANSPORT=auto``: the streaming lane with a sticky
+    per-worker FIFO fallback.
+
+    Each lane tries RPC first; a worker with NO listener (connect
+    refused — the pre-RPC half of a mixed fleet mid-rollout) drops that
+    lane to the FIFO wire and stays there. A worker that ANSWERED on
+    RPC and then failed is a worker failure, not a transport gap — it
+    surfaces as the normal retryable DispatchError and walks the
+    breaker/failover path without switching transports under a chaos
+    drill."""
+
+    def __init__(self, conf: ClusterConfig,
+                 timeout: float | None = None, policy=None,
+                 host_of=None):
+        self.rpc = RpcDispatcher(conf, timeout=timeout,
+                                 host_of=host_of)
+        self.fifo = FifoDispatcher(conf, timeout=timeout, policy=policy,
+                                   host_of=host_of)
+        self._fifo_only: set[int] = set()
+        self._guard = OrderedLock("serving.AutoDispatcher")
+
+    @property
+    def host_of(self):
+        return self.rpc.host_of
+
+    @host_of.setter
+    def host_of(self, fn) -> None:
+        self.rpc.host_of = fn
+        self.fifo.host_of = fn
+
+    def _route(self, meth: str, wid: int, queries, rconf, diff, via):
+        key = wid if via is None else int(via)
+        with self._guard:
+            use_fifo = key in self._fifo_only
+        if not use_fifo:
             try:
-                nodes, moves = read_paths_file(paths_file_for(qfile))
-            except (OSError, ValueError):
-                pass       # old server / no extraction: signature-less
-            return cost, plen, fin, nodes, moves
+                return getattr(self.rpc, meth)(wid, queries, rconf,
+                                               diff, via=via)
+            except RpcUnavailableError as e:
+                with self._guard:
+                    self._fifo_only.add(key)
+                log.warning("worker %d has no rpc listener (%s); lane "
+                            "falls back to the FIFO wire", key, e)
+        return getattr(self.fifo, meth)(wid, queries, rconf, diff,
+                                        via=via)
+
+    def answer_batch(self, wid: int, queries: np.ndarray,
+                     rconf: RuntimeConfig, diff: str,
+                     via: int | None = None):
+        return self._route("answer_batch", wid, queries, rconf, diff,
+                           via)
+
+    def answer_batch_paths(self, wid: int, queries: np.ndarray,
+                           rconf: RuntimeConfig, diff: str,
+                           via: int | None = None):
+        return self._route("answer_batch_paths", wid, queries, rconf,
+                           diff, via)
+
+    def statusz(self) -> dict:
+        out = self.rpc.statusz()
+        out["mode"] = "auto"
+        with self._guard:
+            out["fifo_fallback_lanes"] = sorted(self._fifo_only)
+        return out
+
+    def close(self) -> None:
+        self.rpc.close()
+        self.fifo.close()
 
 
 class CallableDispatcher:

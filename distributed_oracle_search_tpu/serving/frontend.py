@@ -334,6 +334,17 @@ class ServingFrontend:
             out["traffic"] = self.traffic.statusz()
         if self.registry is not None:
             out["breakers"] = self.registry.statusz()
+        # streaming-transport connection table (RPC/auto dispatchers):
+        # per-worker persistent-socket state — connected, in-flight
+        # frames, credit window. Absent for engine/FIFO backends;
+        # `dos-obs top` renders blanks for the missing section
+        tstat = getattr(self.dispatcher, "statusz", None)
+        if tstat is not None:
+            try:
+                out["transport"] = tstat()
+            except Exception as e:  # noqa: BLE001 — statusz must
+                # render even when a dispatcher lane is mid-teardown
+                log.debug("transport statusz unavailable: %s", e)
         return out
 
     def _membership_epoch(self) -> int:

@@ -1,5 +1,8 @@
-"""Head↔worker data plane: wire formats, FIFO transport, job launch,
-liveness probes, and head-side resilience (retry + circuit breaking)."""
+"""Head↔worker data plane: wire formats, the FIFO/NFS campaign
+transport, the streaming RPC transport (:mod:`.frames` length-prefixed
+zero-copy frames over :mod:`.rpc` persistent multiplexed sockets,
+``DOS_TRANSPORT={fifo,rpc,auto}``), job launch, liveness probes, and
+head-side resilience (retry + circuit breaking)."""
 
 from .wire import (
     ENGINE_STAT_FIELDS, HEAD_STAT_FIELDS, STATS_HEADER,

@@ -19,8 +19,10 @@ Extensions over the reference:
 
 from __future__ import annotations
 
+import json
 import os
 import sys
+import threading
 
 import numpy as np
 
@@ -37,6 +39,7 @@ from ..transport.wire import (
 from ..transport.fifo import command_fifo_path
 from ..utils.config import ClusterConfig
 from ..utils.env import env_cast
+from ..utils.locks import OrderedLock
 from ..utils.log import get_logger, set_verbosity, set_worker_id
 from .engine import ShardEngine
 
@@ -86,6 +89,21 @@ M_STALE_DIFF = obs_metrics.counter(
     "batches refused with STALE_DIFF: the request named a fused diff "
     "from a NEWER traffic epoch than this worker's segment stream "
     "shows, even after a refresh")
+G_RPC_CONNS = obs_metrics.gauge(
+    "rpc_server_connections",
+    "live client connections on this worker's RPC accept loop")
+M_RPC_BATCHES = obs_metrics.counter(
+    "rpc_server_batches_total",
+    "batches answered over the socket transport (the RPC twin of "
+    "server_replies_sent_total)")
+M_RPC_DROPPED = obs_metrics.counter(
+    "rpc_server_replies_dropped_total",
+    "RPC replies dropped (drop-reply fault, or the client vanished "
+    "before the reply frame)")
+M_RPC_MALFORMED = obs_metrics.counter(
+    "rpc_server_frames_malformed_total",
+    "request frames whose config was undecodable (answered FAIL, "
+    "never a wedge) — the socket twin of server_frames_malformed_total")
 
 
 class FifoServer:
@@ -148,6 +166,19 @@ class FifoServer:
             log.info("worker %d owns no shard at epoch %d (fresh "
                      "joiner); engines load lazily on adoption "
                      "traffic", wid, self.epoch)
+        #: serializes engine answers across the FIFO and RPC serve
+        #: loops (one ShardEngine, two transports over it)
+        self._answer_lock = OrderedLock("worker.FifoServer.answer")
+
+    @property
+    def answer_lock(self) -> OrderedLock:
+        """The cross-transport answer mutex; created lazily so bare
+        test servers that skip ``__init__`` still serve."""
+        lock = getattr(self, "_answer_lock", None)
+        if lock is None:
+            lock = self._answer_lock = OrderedLock(
+                "worker.FifoServer.answer")
+        return lock
 
     def engine_for_shard(self, shard: int) -> ShardEngine:
         """The engine serving ``shard``'s rows — the primary engine for
@@ -219,6 +250,30 @@ class FifoServer:
         with obs_trace.span("worker.receive", wid=self.wid,
                             queryfile=req.queryfile):
             queries = read_query_file(req.queryfile)
+        cost, plen, fin, stats, paths = self.answer_queries(
+            queries, req.config, req.difffile)
+        if paths is not None:
+            # extraction rides the shared dir, not the stats FIFO (wire
+            # extension: transport.wire.paths_file_for)
+            write_paths_file(paths_file_for(req.queryfile), *paths)
+        if req.config.results and (len(queries)
+                                   or self.engine is not None):
+            # per-query answers for the online serving frontend — same
+            # shared-dir sidecar pattern as .paths (wire extension:
+            # transport.wire.results_file_for). The guard preserves the
+            # pre-refactor shape exactly: an engine-less empty batch
+            # answered the empty row without materializing a sidecar
+            write_results_file(results_file_for(req.queryfile),
+                               cost, plen, fin)
+        return stats
+
+    def answer_queries(self, queries: np.ndarray, config, difffile: str):
+        """The file-less core of one batch — shard-aware engine
+        selection, the engine answer, captured path prefixes — shared
+        by the FIFO serve loop (which wraps it in query-file/sidecar
+        IO) and the RPC serve loop (which ships the same outputs as
+        reply-frame payload segments). Returns ``(cost, plen, fin,
+        stats, paths)`` with ``paths = engine.last_paths`` or None."""
         engine = self.engine
         if len(queries):
             # shard-aware dispatch: a failover/hedge batch targets a
@@ -257,21 +312,11 @@ class FifoServer:
                     f"{np.unique(self.dc.worker_of(queries[:, 1])).tolist()}"
                     " — routing invariant violated")
             # an empty batch needs no engine: answer the empty row
-            return StatsRow()
-        cost, plen, fin, stats = engine.answer(queries, req.config,
-                                               req.difffile)
-        if engine.last_paths is not None:
-            # extraction rides the shared dir, not the stats FIFO (wire
-            # extension: transport.wire.paths_file_for)
-            write_paths_file(paths_file_for(req.queryfile),
-                             *engine.last_paths)
-        if req.config.results:
-            # per-query answers for the online serving frontend — same
-            # shared-dir sidecar pattern as .paths (wire extension:
-            # transport.wire.results_file_for)
-            write_results_file(results_file_for(req.queryfile),
-                               cost, plen, fin)
-        return stats
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, bool), StatsRow(), None)
+        cost, plen, fin, stats = engine.answer(queries, config,
+                                               difffile)
+        return cost, plen, fin, stats, engine.last_paths
 
     def serve_forever(self) -> None:
         """Framed request loop over a PERSISTENT command-FIFO read session.
@@ -380,7 +425,8 @@ class FifoServer:
                     if faults.inject("crash-engine",
                                      wid=self.wid) is not None:
                         raise RuntimeError("injected fault: crash-engine")
-                    stats = self.handle(req)
+                    with self.answer_lock:
+                        stats = self.handle(req)
                     self._batches += 1
                 except Exception as e:  # noqa: BLE001 — never leave
                     # the head blocked on `cat answer`; send a failure
@@ -701,12 +747,318 @@ class FifoServer:
         state = getattr(self, "_membership_state", None)
         if state is not None and state.migration is not None:
             out["migration"] = dict(state.migration)
+        # streaming-transport column: present only when the RPC accept
+        # loop is serving (`dos-obs top` renders blanks for pre-RPC
+        # workers — the same mixed-schema tolerance as the rest)
+        rpc_loop = getattr(self, "rpc_loop", None)
+        if rpc_loop is not None:
+            out["transport"] = rpc_loop.statusz()
         try:
             out["build_ledger_blocks"] = len(
                 BuildLedger(self.conf.outdir, self.wid).entries())
         except (OSError, ValueError):
             out["build_ledger_blocks"] = 0
         return out
+
+
+class RpcServeLoop:
+    """The socket accept loop beside the FIFO serve loop.
+
+    One :class:`FifoServer` (engine, membership/diff epoch gates,
+    health state, fault-injection points) served over persistent
+    connections: length-prefixed frames (:mod:`..transport.frames`),
+    multiplexed by frame id, queries/results as raw ndarray payload
+    segments instead of shared-dir files. Each connection gets a
+    ``hello`` frame advertising the credit window; requests past the
+    window answer an explicit ``busy`` frame instead of queueing into a
+    timeout. ``ping`` frames answer the same
+    :class:`~..transport.wire.HealthStatus` the ``__DOS_PING__``
+    control frame does.
+
+    Every fault point of the FIFO loop fires here too — ``crash-engine``
+    (answered FAIL), ``delay``, ``drop-reply`` (reply frame withheld;
+    the client times out retryable), ``kill-mid-batch`` (``mode=exit``
+    hard-exits; ``mode=raise`` tears the transport down, the in-thread
+    test analog of a crash) — so chaos drills exercise the socket lane
+    through the same ``DOS_FAULTS`` specs."""
+
+    def __init__(self, server: FifoServer, socket_path: str | None = None,
+                 tcp_port: int | None = None, credit: int | None = None):
+        from ..transport import rpc as rpc_transport
+
+        self.fs = server
+        self.socket_path = (socket_path if socket_path is not None
+                            else rpc_transport.rpc_socket_path(server.wid))
+        self.tcp_port = tcp_port
+        self.credit = (credit if credit is not None
+                       else max(1, env_cast("DOS_RPC_CREDIT", 8, int)))
+        self._listener = None
+        self._threads: list = []
+        self._conns: list = []
+        self._stop = threading.Event()
+        self._lock = OrderedLock("worker.RpcServeLoop")
+        self._inflight = 0
+        self._served = 0
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "RpcServeLoop":
+        import socket as _socket
+        import threading as _threading
+
+        if self.tcp_port is not None:
+            lst = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            lst.bind(("0.0.0.0", int(self.tcp_port)))
+            self.endpoint = f"tcp:*:{lst.getsockname()[1]}"
+        else:
+            if os.path.exists(self.socket_path):
+                os.remove(self.socket_path)
+            lst = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            lst.bind(self.socket_path)
+            self.endpoint = f"unix:{self.socket_path}"
+        lst.listen(16)
+        self._listener = lst
+        self.fs.rpc_loop = self     # the /statusz transport section
+        t = _threading.Thread(target=self._accept_loop, daemon=True,
+                              name=f"dos-rpc-accept-w{self.fs.wid}")
+        self._threads.append(t)
+        t.start()
+        log.info("worker %d rpc serving on %s (credit %d)", self.fs.wid,
+                 self.endpoint, self.credit)
+        return self
+
+    def stop(self, join_s: float = 5.0) -> None:
+        self._stop.set()
+        from ..transport.rpc import shutdown_close
+
+        lst, self._listener = self._listener, None
+        if lst is not None:
+            shutdown_close(lst)
+        with self._lock:
+            conns, self._conns = list(self._conns), []
+        for c in conns:
+            shutdown_close(c)
+        with self._lock:
+            threads, self._threads = list(self._threads), []
+        for t in threads:
+            t.join(timeout=join_s)
+        if self.tcp_port is None and os.path.exists(self.socket_path):
+            try:
+                os.remove(self.socket_path)
+            except OSError as e:
+                log.debug("rpc socket unlink failed: %s", e)
+
+    # ------------------------------------------------------------ serving
+    def _accept_loop(self) -> None:
+        import threading as _threading
+
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except (OSError, AttributeError):
+                return      # listener closed by stop()
+            with self._lock:
+                self._conns.append(sock)
+            G_RPC_CONNS.add(1)
+            t = _threading.Thread(
+                target=self._conn_loop, args=(sock,), daemon=True,
+                name=f"dos-rpc-conn-w{self.fs.wid}")
+            with self._lock:
+                self._threads.append(t)
+            t.start()
+
+    def _conn_loop(self, sock) -> None:
+        from ..transport import frames
+
+        reader = frames.FrameReader(sock)
+        writer = frames.FrameWriter(sock)
+        try:
+            writer.send({"kind": "hello", "wid": self.fs.wid,
+                         "credit": self.credit})
+            while not self._stop.is_set():
+                fr = reader.read()
+                if fr is None:
+                    return                  # clean client hangup
+                if fr.kind == "ping":
+                    self._answer_ping(fr, writer)
+                elif fr.kind == "req":
+                    if not self._serve_req(fr, writer):
+                        return              # kill-mid-batch mode=raise
+                else:
+                    # unknown kinds are the schema-tolerance rule
+                    # applied to frames: skip, never kill the session
+                    log.warning("ignoring unknown rpc frame kind %r",
+                                fr.kind)
+        except frames.TransportError as e:
+            log.warning("rpc connection to worker %d died: %s",
+                        self.fs.wid, e)
+        except frames.FrameSchemaError as e:
+            log.error("rpc peer speaks a newer frame schema: %s", e)
+        finally:
+            from ..transport.rpc import shutdown_close
+            shutdown_close(sock)
+            me = threading.current_thread()
+            with self._lock:
+                if sock in self._conns:
+                    self._conns.remove(sock)
+                # prune this handler from the join list: every breaker
+                # probe opens a fresh connection, and a long-lived
+                # worker must not accumulate dead Thread objects
+                if me in self._threads:
+                    self._threads.remove(me)
+            G_RPC_CONNS.add(-1)
+
+    def _answer_ping(self, fr, writer) -> None:
+        from ..transport import frames
+
+        status = self.fs._health_status()
+        try:
+            writer.send({"kind": "health", "id": fr.header.get("id"),
+                         "status": json.loads(status.to_json())})
+            M_PINGS.inc()
+        except frames.TransportError as e:
+            log.warning("rpc health reply failed: %s", e)
+            M_PING_DROPS.inc()
+
+    def _serve_req(self, fr, writer) -> bool:
+        """Answer one ``req`` frame; False tears the transport down
+        (the ``kill-mid-batch`` in-thread analog)."""
+        import time as _time
+
+        from ..transport import frames, rpc as rpc_transport
+        from ..transport.wire import StatsRow as _StatsRow
+
+        fs = self.fs
+        fid = fr.header.get("id")
+        with self._lock:
+            busy = self._inflight >= self.credit
+            if not busy:
+                self._inflight += 1
+        if busy:
+            # explicit backpressure: the client books BUSY now instead
+            # of discovering a saturated worker by timeout
+            rpc_transport.M_BUSY.inc()
+            try:
+                writer.send({"kind": "busy", "id": fid})
+            except frames.TransportError as e:
+                log.warning("rpc busy reply failed: %s", e)
+            return True
+        try:
+            try:
+                rconf = rpc_transport.config_from_wire(
+                    fr.header.get("config"))
+                queries = (np.asarray(fr.arrays[0], np.int64)
+                           .reshape(-1, 2) if fr.arrays
+                           else np.zeros((0, 2), np.int64))
+            except (ValueError, TypeError) as e:
+                log.error("malformed rpc request: %s", e)
+                M_RPC_MALFORMED.inc()
+                self._reply(writer, {"kind": "rep", "id": fid,
+                                     "stats": _StatsRow.failed()
+                                     .encode_wire()})
+                return True
+            diff = str(fr.header.get("diff") or "-")
+            stale = fs._epoch_gate(rconf) or fs._traffic_gate(rconf)
+            if stale is not None:
+                self._reply(writer, {"kind": "rep", "id": fid,
+                                     "stats": stale.encode_wire()})
+                return True
+            kill = faults.inject("kill-mid-batch", wid=fs.wid)
+            if kill is not None:
+                log.error("fault: worker %d dying mid-batch (rpc)",
+                          fs.wid)
+                if kill.mode == "exit":
+                    os._exit(faults.KILL_EXIT_CODE)
+                # mode=raise: the in-thread server dies — stop
+                # accepting, close the listener so new connects are
+                # refused; the torn socket is the client's signal
+                self._stop.set()
+                lst, self._listener = self._listener, None
+                if lst is not None:
+                    rpc_transport.shutdown_close(lst)
+                return False
+            header = {"kind": "rep", "id": fid}
+            arrays: list = []
+            try:
+                if faults.inject("crash-engine", wid=fs.wid) is not None:
+                    raise RuntimeError("injected fault: crash-engine")
+                cost, plen, fin, stats, paths = self._answer(
+                    rconf, queries, diff, header)
+                fs._batches = getattr(fs, "_batches", 0) + 1
+                if rconf.results:
+                    header["res"] = True
+                    arrays += [np.asarray(cost, np.int64),
+                               np.asarray(plen, np.int64),
+                               np.asarray(fin).astype(np.uint8)]
+                if paths is not None:
+                    header["paths"] = True
+                    arrays += [np.asarray(paths[0], np.int64),
+                               np.asarray(paths[1], np.int64)]
+            except Exception as e:  # noqa: BLE001 — never leave the
+                # client waiting on a reply that cannot come; FAIL it
+                log.exception("rpc batch failed: %s", e)
+                M_BATCH_FAIL.inc()
+                fs._batches = getattr(fs, "_batches", 0) + 1
+                fs._batch_failures = getattr(fs, "_batch_failures",
+                                             0) + 1
+                fs._last_error = f"{type(e).__name__}: {e}"
+                stats = _StatsRow.failed()
+                header = {"kind": "rep", "id": fid}
+                arrays = []
+            delay = faults.inject("delay", wid=fs.wid)
+            if delay is not None:
+                log.warning("fault: delaying rpc reply %.2fs",
+                            delay.delay)
+                _time.sleep(delay.delay)
+            if faults.inject("drop-reply", wid=fs.wid) is not None:
+                log.error("fault: dropping rpc reply id=%r", fid)
+                M_RPC_DROPPED.inc()
+                return True
+            header["stats"] = stats.encode_wire()
+            self._reply(writer, header, arrays)
+            M_RPC_BATCHES.inc()
+            with self._lock:
+                self._served += 1
+            return True
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _answer(self, rconf, queries, diff, header):
+        """The engine answer under the cross-transport mutex, with
+        worker-side span capture shipped back IN the reply header
+        (``trace`` events) instead of a ``.trace`` sidecar file."""
+        fs = self.fs
+        if rconf.trace_id:
+            with obs_trace.capture(rconf.trace_id) as cap:
+                with fs.answer_lock:
+                    out = fs.answer_queries(queries, rconf, diff)
+            header["trace"] = cap.events
+            return out
+        with fs.answer_lock:
+            return fs.answer_queries(queries, rconf, diff)
+
+    def _reply(self, writer, header, arrays=()) -> None:
+        from ..transport import frames
+
+        try:
+            writer.send(header, arrays)
+        except frames.TransportError as e:
+            # client vanished before the reply: drop, never crash the
+            # conn loop (its next recv sees the same dead socket)
+            log.warning("rpc reply dropped: %s", e)
+            M_RPC_DROPPED.inc()
+
+    # ------------------------------------------------------------- status
+    def statusz(self) -> dict:
+        with self._lock:
+            return {
+                "endpoint": getattr(self, "endpoint", ""),
+                "connections": len(self._conns),
+                "inflight": int(self._inflight),
+                "credit": int(self.credit),
+                "served": int(self._served),
+            }
 
 
 def stop_server(command_fifo: str, deadline_s: float = 2.0) -> bool:
@@ -776,6 +1128,13 @@ def main(argv=None) -> int:
                    help="diff segment stream directory: gate requests "
                         "whose diff epoch is newer than the stream "
                         "shows (STALE_DIFF wire sentinel)")
+    p.add_argument("--rpc-socket", default=None,
+                   help="unix socket for the streaming RPC serve loop "
+                        "(default under DOS_TRANSPORT=rpc/auto: "
+                        "DOS_RPC_SOCKET_DIR/dos-rpc-worker<wid>.sock)")
+    p.add_argument("--rpc-port", type=int, default=None,
+                   help="TCP port for the RPC serve loop (cross-host; "
+                        "DOS_RPC_PORT+wid when the env base is set)")
     args = p.parse_args(argv)
     set_verbosity(args.verbose)
     set_worker_id(args.workerid)
@@ -783,6 +1142,22 @@ def main(argv=None) -> int:
     conf = ClusterConfig.load(args.c)
     server = FifoServer(conf, args.workerid, command_fifo=args.fifo,
                         alg=args.alg, traffic_dir=args.traffic_dir)
+    # the streaming data plane serves BESIDE the FIFO loop (same
+    # engine, same gates): on under DOS_TRANSPORT=rpc/auto or when an
+    # explicit endpoint flag names one; off (byte-identical legacy)
+    # under the default DOS_TRANSPORT=fifo
+    from ..transport import rpc as rpc_transport
+    rpc_loop = None
+    want_rpc = (args.rpc_socket is not None or args.rpc_port is not None
+                or rpc_transport.resolve_transport() != "fifo")
+    if want_rpc:
+        port = args.rpc_port
+        if port is None:
+            base = env_cast("DOS_RPC_PORT", 0, int)
+            port = base + args.workerid if base > 0 else None
+        rpc_loop = RpcServeLoop(server, socket_path=args.rpc_socket,
+                                tcp_port=port).start()
+        server.rpc_loop = rpc_loop
     from ..obs.http import start_obs_server
     obs_srv = start_obs_server(
         args.obs_port, health_fn=server.health,
@@ -790,6 +1165,8 @@ def main(argv=None) -> int:
     try:
         server.serve_forever()
     finally:
+        if rpc_loop is not None:
+            rpc_loop.stop()
         if obs_srv is not None:
             obs_srv.close()
         if args.metrics_dump:

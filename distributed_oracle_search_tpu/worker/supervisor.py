@@ -93,7 +93,12 @@ class WorkerSupervisor:
                  unhealthy_pings: int | None = None,
                  probe_timeout_s: float = 10.0,
                  spawn_fn=None, probe_fn=None,
-                 traffic_dir: str | None = None):
+                 traffic_dir: str | None = None,
+                 rpc_dir: str | None = None):
+        #: where spawned servers bind their streaming-RPC unix sockets
+        #: (DOS_TRANSPORT=rpc/auto): overrides DOS_RPC_SOCKET_DIR so a
+        #: test fleet's sockets land beside its FIFOs, not in /tmp
+        self.rpc_dir = rpc_dir
         self.conf = conf
         self.conf_path = conf_path
         self.alg = alg
@@ -131,6 +136,14 @@ class WorkerSupervisor:
             return os.path.join(self.fifo_dir, f"worker{wid}.fifo")
         return fifo_transport.command_fifo_path(wid)
 
+    def _rpc_socket_for(self, wid: int) -> str:
+        from ..transport import rpc as rpc_transport
+
+        if self.rpc_dir:
+            return os.path.join(self.rpc_dir,
+                                f"dos-rpc-worker{wid}.sock")
+        return rpc_transport.rpc_socket_path(wid)
+
     def _spawn_server(self, w: SupervisedWorker) -> subprocess.Popen:
         if not self.conf_path:
             raise ValueError("supervising real servers needs conf_path")
@@ -140,6 +153,13 @@ class WorkerSupervisor:
                "--fifo", w.fifo, "--alg", self.alg]
         if self.traffic_dir:
             cmd += ["--traffic-dir", self.traffic_dir]
+        # streaming data plane: when the fleet runs DOS_TRANSPORT=rpc/
+        # auto (or the caller pinned a socket dir), spawned servers get
+        # an explicit per-worker socket so respawns land on the SAME
+        # endpoint the head's persistent clients reconnect to
+        from ..transport import rpc as rpc_transport
+        if self.rpc_dir or rpc_transport.resolve_transport() != "fifo":
+            cmd += ["--rpc-socket", self._rpc_socket_for(w.wid)]
         out = subprocess.DEVNULL
         if self.logdir:
             os.makedirs(self.logdir, exist_ok=True)

@@ -7,11 +7,34 @@ def bad_blocking_open(fifo_path):
     return os.open(fifo_path, os.O_WRONLY)
 
 
+def bad_bare_recv(sock):
+    # the socket half: a bare recv outside transport/frames.py can
+    # return a partial frame and desync the stream
+    return sock.recv(4096)
+
+
+def bad_bare_sendall(sock, payload):
+    sock.sendall(payload)
+
+
 def suppressed_blocking_open(fifo_path):
     # dos-lint: disable=fifo-hygiene -- fixture: peer lifetime pinned
     #   by the test harness, open cannot wedge
     return open(fifo_path, "r")
 
 
+def suppressed_bare_recv_into(sock, buf):
+    # dos-lint: disable=fifo-hygiene -- fixture: a raw-byte diagnostic
+    #   probe that never parses frames off this socket
+    return sock.recv_into(buf)
+
+
 def clean_bounded_open(fifo_path):
     return os.open(fifo_path, os.O_WRONLY | os.O_NONBLOCK)
+
+
+def clean_framed_wire(sock, frame_writer, frame_reader):
+    # wire IO through the frame codec's reader/writer is the pattern
+    frame_writer.send({"kind": "ping"})
+    sock.close()
+    return frame_reader

@@ -1,0 +1,192 @@
+"""Frame-codec compat suite: the streaming wire's version of the
+manifest/membership/segment codec contracts.
+
+Unknown header keys are tolerated, ONLY newer frame-schema versions are
+refused, arrays round-trip as zero-copy views, and every torn/truncated
+frame on a dead socket surfaces as a typed retryable transport error —
+never a hang, never a crash three layers up."""
+
+import socket
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.transport import frames
+from distributed_oracle_search_tpu.transport.frames import (
+    FRAME_SCHEMA_VERSION, FrameReader, FrameSchemaError, FrameWriter,
+    TornFrame, TransportError, decode_header, encode_frame,
+)
+
+
+@pytest.fixture
+def pair():
+    a, b = socket.socketpair()
+    yield a, b
+    a.close()
+    b.close()
+
+
+def _rt(pair, header, arrays=()):
+    a, b = pair
+    FrameWriter(a).send(header, arrays)
+    return FrameReader(b).read()
+
+
+# ------------------------------------------------------------ round trips
+
+def test_frame_roundtrip_header_and_arrays(pair):
+    q = np.arange(12, dtype=np.int64).reshape(6, 2)
+    fin = np.array([1, 0, 1], np.uint8)
+    fr = _rt(pair, {"kind": "req", "config": {"hscale": 1.5},
+                    "diff": "-"}, [q, fin])
+    assert fr.kind == "req"
+    assert fr.header["config"] == {"hscale": 1.5}
+    assert (fr.arrays[0] == q).all() and fr.arrays[0].dtype == np.int64
+    assert (fr.arrays[1] == fin).all() and fr.arrays[1].dtype == np.uint8
+
+
+def test_frame_arrays_are_zero_copy_views(pair):
+    q = np.arange(64, dtype=np.int64)
+    fr = _rt(pair, {"kind": "rep"}, [q])
+    # decoded arrays are frombuffer views into the one receive buffer,
+    # not parsed copies — the no-savetxt-on-the-hot-path contract
+    assert fr.arrays[0].base is not None
+
+
+def test_unaligned_segment_still_decodes_aligned(pair):
+    # a uint8 segment between two int64 ones: the 8-byte segment
+    # padding keeps every view aligned
+    fr = _rt(pair, {"kind": "rep"},
+             [np.arange(4, dtype=np.int64), np.array([1, 0, 1], np.uint8),
+              np.arange(6, dtype=np.int64).reshape(2, 3)])
+    assert (fr.arrays[2] == np.arange(6).reshape(2, 3)).all()
+
+
+def test_empty_payload_and_multiple_frames(pair):
+    a, b = pair
+    w, r = FrameWriter(a), FrameReader(b)
+    w.send({"kind": "ping"})
+    w.send({"kind": "ping", "n": 2})
+    f1, f2 = r.read(), r.read()
+    assert f1.kind == f2.kind == "ping"
+    assert f2.header["n"] == 2 and f1.arrays == []
+
+
+def test_clean_eof_between_frames_is_none(pair):
+    a, b = pair
+    FrameWriter(a).send({"kind": "ping"})
+    a.close()
+    r = FrameReader(b)
+    assert r.read().kind == "ping"
+    assert r.read() is None        # peer closed AT a frame boundary
+
+
+# -------------------------------------------------------- compat contract
+
+def test_unknown_header_keys_tolerated(pair):
+    fr = _rt(pair, {"kind": "req", "future_knob": {"deep": [1, 2]}})
+    assert fr.header["future_knob"] == {"deep": [1, 2]}
+
+
+def test_unknown_frame_kind_decodes(pair):
+    # receivers skip unknown kinds; the codec itself must not refuse
+    fr = _rt(pair, {"kind": "gossip", "payload": 1})
+    assert fr.kind == "gossip"
+
+
+def test_older_and_absent_version_tolerated(pair):
+    a, b = pair
+    w, r = FrameWriter(a), FrameReader(b)
+    w.send({"kind": "req", "v": 0})
+    assert r.read().kind == "req"
+    assert decode_header(b'{"kind": "req"}')["kind"] == "req"
+
+
+def test_newer_version_refused(pair):
+    a, b = pair
+    FrameWriter(a).send({"kind": "req",
+                         "v": FRAME_SCHEMA_VERSION + 1})
+    with pytest.raises(FrameSchemaError, match="newer"):
+        FrameReader(b).read()
+
+
+def test_schema_error_is_not_retryable_transport_error():
+    # the dispatcher retries TransportError; a schema gate must NOT
+    # loop forever on a reconnect that meets the same peer
+    assert not issubclass(FrameSchemaError, TransportError)
+    assert issubclass(TornFrame, TransportError)
+
+
+# ------------------------------------------------------- torn-frame paths
+
+def _raw(header, arrays=()):
+    return b"".join(bytes(x) for x in encode_frame(header, arrays))
+
+
+def test_peer_death_mid_frame_is_torn(pair):
+    a, b = pair
+    raw = _raw({"kind": "req"}, [np.arange(32, dtype=np.int64)])
+    a.sendall(raw[: len(raw) // 2])
+    a.close()
+    with pytest.raises(TornFrame):
+        FrameReader(b).read()
+
+
+def test_bad_magic_is_torn(pair):
+    a, b = pair
+    a.sendall(b"GARBAGEGARBAGEGARBAGE")
+    a.close()
+    with pytest.raises(TornFrame, match="magic"):
+        FrameReader(b).read()
+
+
+def test_implausible_lengths_are_torn_not_alloc(pair):
+    import struct
+
+    a, b = pair
+    a.sendall(frames.MAGIC + struct.pack("<IQ", 16, 1 << 62))
+    a.close()
+    with pytest.raises(TornFrame, match="implausible"):
+        FrameReader(b).read()
+
+
+def test_undecodable_header_is_torn(pair):
+    import struct
+
+    a, b = pair
+    hdr = b"not json at all!"
+    a.sendall(frames.MAGIC + struct.pack("<IQ", len(hdr), 0) + hdr)
+    with pytest.raises(TornFrame, match="undecodable"):
+        FrameReader(b).read()
+
+
+def test_truncated_payload_vs_segs_is_torn(pair):
+    import struct
+    import json as _json
+
+    a, b = pair
+    # header promises a 256-byte segment; payload carries 8 bytes
+    hdr = _json.dumps({"kind": "rep", "v": 1,
+                       "segs": [{"dtype": "<i8",
+                                 "shape": [32]}]}).encode()
+    a.sendall(frames.MAGIC + struct.pack("<IQ", len(hdr), 8) + hdr
+              + b"\x00" * 8)
+    with pytest.raises(TornFrame, match="truncated"):
+        FrameReader(b).read()
+
+
+def test_send_on_dead_socket_is_transport_error(pair):
+    a, b = pair
+    b.close()
+    a.close()
+    with pytest.raises(TransportError):
+        FrameWriter(a).send({"kind": "ping"})
+
+
+def test_bounded_read_on_timeout_socket(pair):
+    # a socket carrying a timeout never hangs the reader: the timeout
+    # surfaces as a retryable transport error
+    a, b = pair
+    b.settimeout(0.1)
+    with pytest.raises(TornFrame):
+        FrameReader(b).read()

@@ -1,0 +1,678 @@
+"""Streaming RPC data plane, end to end.
+
+One fleet of in-thread workers serves BOTH transports from the same
+engines (the FIFO serve loop and the socket accept loop share each
+``FifoServer``); the suite pins parity with the FIFO wire, multiplexed
+in-flight batches on one socket, explicit BUSY backpressure, the
+membership + diff epoch gates over sockets, the hedged-dispatch
+query-file reuse on the FIFO backend, and the acceptance chaos drill:
+kill-mid-batch + drop-reply over sockets completing degraded-not-wedged
+with answers bit-identical to the fault-free FIFO run."""
+
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import distributed_oracle_search_tpu.serving.dispatch as dmod
+from distributed_oracle_search_tpu.cli import process_query as pq
+from distributed_oracle_search_tpu.data import (
+    ensure_synth_dataset, read_scen,
+)
+from distributed_oracle_search_tpu.data.graph import Graph
+from distributed_oracle_search_tpu.models.cpd import (
+    build_replica_shards, build_worker_shard, write_index_manifest,
+)
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.serving import (
+    AutoDispatcher, DispatchError, FifoDispatcher, HedgeConfig,
+    RpcDispatcher, ServeConfig, ServingFrontend,
+)
+from distributed_oracle_search_tpu.testing import faults
+from distributed_oracle_search_tpu.transport import resilience
+from distributed_oracle_search_tpu.transport import rpc as rpc_transport
+from distributed_oracle_search_tpu.transport.frames import TransportError
+from distributed_oracle_search_tpu.transport.wire import RuntimeConfig
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker import FifoServer, stop_server
+from distributed_oracle_search_tpu.worker import supervisor as sup_mod
+from distributed_oracle_search_tpu.worker.build import main as build_main
+from distributed_oracle_search_tpu.worker.server import RpcServeLoop
+
+pytestmark = pytest.mark.rpc
+
+N_WORKERS = 2
+
+
+def _counter(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot()["counters"].get(name, 0)
+
+
+# -------------------------------------------------------------- fixtures
+
+@pytest.fixture(scope="module")
+def rpc_world(tmp_path_factory):
+    """2-shard R=2 world with primary + replica CPD shards built (the
+    bench repl-section pattern), so failover and hedging have a live
+    second lane."""
+    datadir = str(tmp_path_factory.mktemp("rpc-world"))
+    paths = ensure_synth_dataset(datadir, width=10, height=8,
+                                 n_queries=96, seed=29)
+    conf = ClusterConfig(
+        workers=["localhost"] * N_WORKERS,
+        partmethod="mod", partkey=N_WORKERS,
+        outdir=os.path.join(datadir, "index"),
+        xy_file=paths["xy"], scenfile=paths["scen"],
+        nfs=datadir, replication=2,
+    ).validate()
+    g = Graph.from_xy(conf.xy_file)
+    dc = DistributionController("mod", N_WORKERS, N_WORKERS, g.n,
+                                replication=2)
+    for wid in range(N_WORKERS):
+        build_worker_shard(g, dc, wid, conf.outdir)
+        build_replica_shards(g, dc, wid, conf.outdir)
+    write_index_manifest(conf.outdir, dc)
+    queries = read_scen(conf.scenfile)
+    return conf, g, dc, queries
+
+
+class _Fleet:
+    """Both workers serving both transports, restartable per lane."""
+
+    def __init__(self, conf, sockdir):
+        self.conf = conf
+        self.sockdir = sockdir
+        self.servers = {}
+        self.threads = {}
+        self.loops = {}
+        for wid in range(N_WORKERS):
+            srv = FifoServer(conf, wid, command_fifo=self.fifo_of(wid))
+            th = threading.Thread(target=srv.serve_forever, daemon=True)
+            th.start()
+            self.servers[wid] = srv
+            self.threads[wid] = th
+            self.loops[wid] = RpcServeLoop(
+                srv, socket_path=self.sock_of(wid)).start()
+        for wid in range(N_WORKERS):
+            for _ in range(200):
+                if os.path.exists(self.fifo_of(wid)):
+                    break
+                time.sleep(0.02)
+
+    def fifo_of(self, wid: int) -> str:
+        return os.path.join(self.sockdir, f"worker{wid}.fifo")
+
+    def sock_of(self, wid: int) -> str:
+        return os.path.join(self.sockdir, f"dos-rpc-worker{wid}.sock")
+
+    def restart_rpc(self, wid: int) -> None:
+        """Bring a torn-down accept loop back on the SAME endpoint (the
+        in-thread analog of a supervisor respawn)."""
+        self.loops[wid].stop(join_s=2.0)
+        self.loops[wid] = RpcServeLoop(
+            self.servers[wid], socket_path=self.sock_of(wid)).start()
+
+    def stop(self) -> None:
+        for wid in range(N_WORKERS):
+            stop_server(self.fifo_of(wid), deadline_s=5.0)
+        for th in self.threads.values():
+            th.join(timeout=15)
+        for loop in self.loops.values():
+            loop.stop()
+
+
+@pytest.fixture(scope="module")
+def rpc_fleet(rpc_world, tmp_path_factory):
+    conf, g, dc, queries = rpc_world
+    sockdir = str(tmp_path_factory.mktemp("rpc-socks"))
+    old = os.environ.get("DOS_RPC_SOCKET_DIR")
+    os.environ["DOS_RPC_SOCKET_DIR"] = sockdir
+    fleet = _Fleet(conf, sockdir)
+    yield conf, g, dc, queries, fleet
+    fleet.stop()
+    if old is None:
+        os.environ.pop("DOS_RPC_SOCKET_DIR", None)
+    else:
+        os.environ["DOS_RPC_SOCKET_DIR"] = old
+
+
+def _frontend(dc, dispatcher, registry=None, hedge_enabled=False,
+              **hedge_kw):
+    return ServingFrontend(
+        dc, dispatcher,
+        sconf=ServeConfig(max_batch=8, max_wait_ms=2.0,
+                          queue_depth=1024, cache_bytes=0,
+                          deadline_ms=60_000.0),
+        registry=registry,
+        hconf=HedgeConfig(enabled=hedge_enabled, **hedge_kw))
+
+
+def _run_pool(fe, pool):
+    fe.start()
+    try:
+        futs = [fe.submit(int(s), int(t)) for s, t in pool]
+        return [f.result(60) for f in futs]
+    finally:
+        fe.stop()
+
+
+# --------------------------------------------------------------- parity
+
+def test_transport_knob_defaults_to_fifo_legacy(monkeypatch):
+    """DOS_TRANSPORT unset (or malformed) is the byte-identical legacy
+    path: every pre-existing suite runs it, and the knob degrades
+    instead of crashing (the utils.env policy)."""
+    monkeypatch.delenv("DOS_TRANSPORT", raising=False)
+    assert rpc_transport.resolve_transport() == "fifo"
+    monkeypatch.setenv("DOS_TRANSPORT", "bogus")
+    assert rpc_transport.resolve_transport() == "fifo"
+    monkeypatch.setenv("DOS_TRANSPORT", " RPC ")
+    assert rpc_transport.resolve_transport() == "rpc"
+    monkeypatch.setenv("DOS_TRANSPORT", "auto")
+    assert rpc_transport.resolve_transport() == "auto"
+
+def test_rpc_dispatch_matches_engine(rpc_fleet):
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    mine = queries[dc.worker_of(queries[:, 1]) == 1][:8]
+    disp = RpcDispatcher(conf, timeout=60.0)
+    try:
+        cost, plen, fin = disp.answer_batch(1, mine, RuntimeConfig(),
+                                            "-")
+        c2, p2, f2, _ = fleet.servers[1].engine.answer(
+            mine, RuntimeConfig())
+        assert (cost == c2).all() and (plen == p2).all()
+        assert (fin == np.asarray(f2)).all()
+    finally:
+        disp.close()
+
+
+def test_rpc_paths_segments_match_engine_capture(rpc_fleet):
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    mine = queries[dc.worker_of(queries[:, 1]) == 0][:6]
+    rc = RuntimeConfig(sig_k=4)
+    disp = RpcDispatcher(conf, timeout=60.0)
+    try:
+        cost, plen, fin, nodes, moves = disp.answer_batch_paths(
+            0, mine, rc, "-")
+        assert nodes is not None and moves is not None
+        eng = fleet.servers[0].engine
+        with fleet.servers[0].answer_lock:
+            c2, p2, f2, _ = eng.answer(mine, rc)
+            n2, m2 = eng.last_paths
+        assert (cost == c2).all()
+        assert (nodes == np.asarray(n2)).all()
+        assert (moves == np.asarray(m2)).all()
+    finally:
+        disp.close()
+
+
+def test_rpc_frontend_bit_identical_to_fifo_frontend(rpc_fleet,
+                                                     monkeypatch):
+    """The serving acceptance: the same pool through the FIFO wire and
+    the socket wire answers identically (cache off, so every answer is
+    a live dispatch)."""
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    monkeypatch.setattr(dmod, "command_fifo_path", fleet.fifo_of)
+    pool = queries[:40]
+    fifo_res = _run_pool(_frontend(dc, FifoDispatcher(
+        conf, timeout=60.0)), pool)
+    rpc_res = _run_pool(_frontend(dc, RpcDispatcher(
+        conf, timeout=60.0)), pool)
+    assert all(r.ok for r in fifo_res) and all(r.ok for r in rpc_res)
+    assert [(r.cost, r.plen, r.finished) for r in rpc_res] == \
+        [(r.cost, r.plen, r.finished) for r in fifo_res]
+
+
+# --------------------------------------------- multiplexing/backpressure
+
+def test_multiplexed_batches_share_one_connection(rpc_fleet):
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    mine = queries[dc.worker_of(queries[:, 1]) == 1][:8]
+    disp = RpcDispatcher(conf, timeout=60.0)
+    c0 = _counter("rpc_connects_total")
+    try:
+        golden = disp.answer_batch(1, mine, RuntimeConfig(), "-")
+        outs = {}
+
+        def go(i):
+            outs[i] = disp.answer_batch(1, mine, RuntimeConfig(), "-")
+
+        ths = [threading.Thread(target=go, args=(i,)) for i in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=60)
+        assert all((outs[i][0] == golden[0]).all() for i in range(4))
+        st = disp.statusz()
+        assert st["mode"] == "rpc"
+        assert st["connections"]["1"]["connected"] is True
+        assert st["connections"]["1"]["connects"] == 1
+        # 4 concurrent batches never opened a second connection
+        assert _counter("rpc_connects_total") - c0 == 1
+    finally:
+        disp.close()
+
+
+def test_busy_frame_is_explicit_backpressure(rpc_fleet, tmp_path,
+                                             monkeypatch):
+    """A request past the server's credit window answers an explicit
+    BUSY frame — booked on rpc_busy_frames_total, surfaced as RpcBusy —
+    instead of queueing into a timeout."""
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "delay;wid=1;delay=0.8;times=1")
+    sock = str(tmp_path / "busy.sock")
+    loop = RpcServeLoop(fleet.servers[1], socket_path=sock,
+                        credit=1).start()
+    mine = np.ascontiguousarray(
+        queries[dc.worker_of(queries[:, 1]) == 1][:4], np.int64)
+    ca = rpc_transport.RpcClient(("unix", sock, None), timeout_s=30.0)
+    cb = rpc_transport.RpcClient(("unix", sock, None), timeout_s=30.0)
+    busy0 = _counter("rpc_busy_frames_total")
+    hdr = {"kind": "req",
+           "config": {"results": True}, "diff": "-"}
+    got = {}
+
+    def slow():
+        got["a"] = ca.call(dict(hdr), [mine])
+
+    th = threading.Thread(target=slow)
+    try:
+        th.start()
+        time.sleep(0.25)        # inside worker 1's injected delay
+        with pytest.raises(rpc_transport.RpcBusy):
+            cb.call(dict(hdr), [mine])
+        th.join(timeout=30)
+        assert got["a"].header.get("res")
+        assert _counter("rpc_busy_frames_total") - busy0 >= 2
+    finally:
+        th.join(timeout=5)
+        ca.close()
+        cb.close()
+        loop.stop()
+
+
+# ------------------------------------------------------------ the gates
+
+def test_stale_epoch_gate_over_sockets(rpc_fleet):
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    mine = queries[dc.worker_of(queries[:, 1]) == 1][:4]
+    disp = RpcDispatcher(conf, timeout=30.0)
+    s0 = _counter("server_stale_epoch_total")
+    try:
+        # tolerate-older: epoch 0 (and the worker's own epoch) serves
+        disp.answer_batch(1, mine, RuntimeConfig(epoch=0), "-")
+        # gate-newer: a NEWER table version refuses with the sentinel
+        with pytest.raises(DispatchError, match="STALE_EPOCH"):
+            disp.answer_batch(1, mine, RuntimeConfig(epoch=99), "-")
+        assert _counter("server_stale_epoch_total") - s0 == 1
+    finally:
+        disp.close()
+
+
+def test_stale_diff_gate_over_sockets(rpc_world, tmp_path, monkeypatch):
+    conf, g, dc, queries = rpc_world
+    faults.reset()
+    monkeypatch.setenv("DOS_RPC_SOCKET_DIR", str(tmp_path))
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    srv = FifoServer(conf, 1,
+                     command_fifo=str(tmp_path / "w1.fifo"),
+                     traffic_dir=str(stream))
+    loop = RpcServeLoop(srv).start()
+    mine = queries[dc.worker_of(queries[:, 1]) == 1][:4]
+    disp = RpcDispatcher(conf, timeout=30.0)
+    d0 = _counter("server_stale_diff_total")
+    try:
+        disp.answer_batch(1, mine, RuntimeConfig(diff_epoch=0), "-")
+        with pytest.raises(DispatchError, match="STALE_DIFF"):
+            disp.answer_batch(1, mine, RuntimeConfig(diff_epoch=7), "-")
+        assert _counter("server_stale_diff_total") - d0 == 1
+    finally:
+        disp.close()
+        loop.stop()
+
+
+# ------------------------------------------------------------- liveness
+
+def test_rpc_probe_rides_health_vocabulary(rpc_fleet):
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    st = rpc_transport.probe(1)
+    assert st is not None and st.ok and st.wid == 1
+    # no listener -> None, never a hang (the fifo probe contract)
+    assert rpc_transport.probe(57, timeout=3.0) is None
+
+
+def test_malformed_config_answers_fail_not_wedge(rpc_fleet):
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    client = rpc_transport.RpcClient(
+        ("unix", fleet.sock_of(1), None), timeout_s=15.0)
+    m0 = _counter("rpc_server_frames_malformed_total")
+    try:
+        fr = client.call({"kind": "req", "config": "CORRUPT {",
+                          "diff": "-"},
+                         [np.zeros((1, 2), np.int64)])
+        assert fr.header["stats"] == "FAIL"
+        assert _counter("rpc_server_frames_malformed_total") - m0 == 1
+    finally:
+        client.close()
+
+
+# ------------------------------------------------------ the chaos drill
+
+def test_rpc_chaos_drill_degraded_not_wedged(rpc_fleet, monkeypatch):
+    """The acceptance drill: kill-mid-batch on worker 0 and drop-reply
+    on worker 1 (the existing testing/faults hooks) over sockets. Every
+    request still answers OK — failover walks to the replica, breakers
+    open and short-circuit, transport errors are typed and retryable —
+    and the answers are bit-identical to the fault-free FIFO run over
+    the same pool."""
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+    monkeypatch.setattr(dmod, "command_fifo_path", fleet.fifo_of)
+    pool = queries[:40]
+
+    # golden: the fault-free FIFO run (the compat backend, unchanged)
+    golden = _run_pool(_frontend(dc, FifoDispatcher(
+        conf, timeout=60.0)), pool)
+    assert all(r.ok for r in golden)
+    gold = [(r.cost, r.plen, r.finished) for r in golden]
+
+    # phase 1: kill-mid-batch tears worker 0's transport mid-batch;
+    # the batch fails over to worker 1's replica, the breaker opens
+    # after threshold-1 failures and later shard-0 batches skip the
+    # corpse without a connect attempt
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "kill-mid-batch;wid=0;mode=raise")
+    fo0 = _counter("failover_total")
+    te0 = _counter("rpc_transport_errors_total")
+    op0 = _counter("head_circuit_open_total")
+    reg = resilience.BreakerRegistry(threshold=1, cooldown_s=600.0,
+                                     enabled=True)
+    res1 = _run_pool(_frontend(dc, RpcDispatcher(conf, timeout=10.0),
+                               registry=reg), pool)
+    reg.shutdown()
+    assert all(r.ok for r in res1), [r.detail for r in res1
+                                     if not r.ok]
+    assert [(r.cost, r.plen, r.finished) for r in res1] == gold
+    assert _counter("failover_total") - fo0 >= 1
+    assert _counter("rpc_transport_errors_total") - te0 >= 1
+    assert _counter("head_circuit_open_total") - op0 >= 1
+
+    # phase 2: worker 0 "respawns" on the same endpoint; worker 1
+    # drops one reply — the client times out (typed, retryable), the
+    # batch fails over to worker 0's replica, nothing wedges
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "drop-reply;wid=1;times=1")
+    fleet.restart_rpc(0)
+    dr0 = _counter("rpc_server_replies_dropped_total")
+    reg2 = resilience.BreakerRegistry(threshold=3, cooldown_s=600.0,
+                                      enabled=True)
+    res2 = _run_pool(_frontend(dc, RpcDispatcher(conf, timeout=3.0),
+                               registry=reg2), pool)
+    reg2.shutdown()
+    assert all(r.ok for r in res2), [r.detail for r in res2
+                                     if not r.ok]
+    assert [(r.cost, r.plen, r.finished) for r in res2] == gold
+    assert _counter("rpc_server_replies_dropped_total") - dr0 == 1
+
+
+def test_hedge_over_rpc_wins_against_slow_primary(rpc_fleet,
+                                                  monkeypatch):
+    """Hedged dispatch over sockets: the duplicate shares the replica's
+    persistent connection and beats a delay-faulted primary."""
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS",
+                       "delay;wid=0;delay=0.3;times=inf")
+    mine = queries[dc.worker_of(queries[:, 1]) == 0][:6]
+    hi0 = _counter("hedges_issued_total")
+    hw0 = _counter("hedges_won_total")
+    fe = _frontend(dc, RpcDispatcher(conf, timeout=30.0),
+                   hedge_enabled=True, min_delay_ms=5.0, budget=1.0)
+    fe.start()
+    try:
+        res = [fe.query(int(s), int(t), timeout=60) for s, t in mine]
+    finally:
+        fe.stop()
+        time.sleep(0.5)     # drain delayed loser replies
+    assert all(r.ok for r in res)
+    assert _counter("hedges_issued_total") - hi0 >= 1
+    assert _counter("hedges_won_total") - hw0 >= 1
+
+
+# ------------------------------------------- fifo hedge satellite + auto
+
+def test_hedged_fifo_dispatch_reuses_primary_query_file(rpc_fleet,
+                                                        monkeypatch):
+    """The ROADMAP item-3 callout: a hedge duplicate on the FIFO
+    backend reuses the primary attempt's already-written query file
+    instead of paying a second filesystem round-trip per candidate."""
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS",
+                       "delay;wid=0;delay=0.3;times=inf")
+    monkeypatch.setattr(dmod, "command_fifo_path", fleet.fifo_of)
+    mine = queries[dc.worker_of(queries[:, 1]) == 0][:6]
+    r0 = _counter("serve_hedge_qfile_reused_total")
+    fe = _frontend(dc, FifoDispatcher(conf, timeout=60.0),
+                   hedge_enabled=True, min_delay_ms=5.0, budget=1.0)
+    fe.start()
+    try:
+        res = [fe.query(int(s), int(t), timeout=60) for s, t in mine]
+    finally:
+        fe.stop()
+        time.sleep(0.5)     # drain delayed loser replies
+    assert all(r.ok for r in res)
+    assert _counter("serve_hedge_qfile_reused_total") - r0 >= 1
+
+
+def test_sweep_defers_unlink_while_shared_qfile_in_flight(rpc_world,
+                                                          tmp_path):
+    """The cross-lane race the reuse refcount exists for: the writer
+    lane's NEXT dispatch sweeps its previous batch while a hedge on
+    another lane still has the shared query file in flight — the
+    physical unlink must defer to the last reference's release, never
+    tear the in-flight attempt's read. (White-box: the interleaving
+    cannot be scheduled reliably over the real wire.)"""
+    conf, g, dc, queries = rpc_world
+    disp = FifoDispatcher(conf)
+    qfile = str(tmp_path / "query.serve.shared")
+    open(qfile, "w").write("0\n")
+    qkey = (0, 1, 123, "-")
+    disp._shared_q[qkey] = [qfile, 1, False, b"x"]   # hedge in flight
+    disp._prev[(0, 0)] = (qfile, str(tmp_path / "answer.base"))
+    disp._sweep_prev((0, 0))
+    assert os.path.exists(qfile), "sweep tore an in-flight shared file"
+    assert disp._shared_q[qkey][2] is True           # orphaned
+    # the last reference's release unlinks it (the _dispatch finally)
+    ent = disp._shared_q.pop(qkey)
+    ent[1] -= 1
+    assert ent[1] == 0 and ent[2]
+    disp._unlink_batch_files(ent[0])
+    assert not os.path.exists(qfile)
+
+
+def test_auto_dispatcher_sticky_fifo_fallback(rpc_world, rpc_fleet,
+                                              tmp_path, monkeypatch):
+    """DOS_TRANSPORT=auto on a mixed fleet: worker 1 has a listener
+    (rpc), worker 0 does not (fifo fallback), and the lane choice is
+    sticky + visible in statusz."""
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    # a socket dir where ONLY worker 1 listens
+    monkeypatch.setenv("DOS_RPC_SOCKET_DIR", str(tmp_path))
+    monkeypatch.setattr(dmod, "command_fifo_path", fleet.fifo_of)
+    loop1 = RpcServeLoop(fleet.servers[1],
+                         socket_path=rpc_transport.rpc_socket_path(1)
+                         ).start()
+    disp = AutoDispatcher(conf, timeout=60.0)
+    try:
+        rc = RuntimeConfig()
+        m0 = queries[dc.worker_of(queries[:, 1]) == 0][:4]
+        m1 = queries[dc.worker_of(queries[:, 1]) == 1][:4]
+        c0, _, _ = disp.answer_batch(0, m0, rc, "-")
+        c1, _, _ = disp.answer_batch(1, m1, rc, "-")
+        ce0, _, _, _ = fleet.servers[0].engine.answer(m0, rc)
+        ce1, _, _, _ = fleet.servers[1].engine.answer(m1, rc)
+        assert (c0 == ce0).all() and (c1 == ce1).all()
+        st = disp.statusz()
+        assert st["mode"] == "auto"
+        assert st["fifo_fallback_lanes"] == [0]
+        assert st["connections"]["1"]["connected"] is True
+    finally:
+        disp.close()
+        loop1.stop()
+
+
+# -------------------------------------------------------- campaign lane
+
+def test_campaign_over_rpc_writes_no_query_files(rpc_world, rpc_fleet,
+                                                 tmp_path, monkeypatch):
+    """The campaign CLI on DOS_TRANSPORT=rpc: clean exit, parts.csv,
+    and ZERO per-batch query files on the shared dir — the hot path
+    really stopped touching the filesystem."""
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    monkeypatch.delenv("DOS_FAULTS", raising=False)
+    monkeypatch.setenv("DOS_TRANSPORT", "rpc")
+    conf_path = os.path.join(fleet.sockdir, "conf-rpc-campaign.json")
+    conf.save(conf_path)
+    monkeypatch.setattr(pq, "command_fifo_path", fleet.fifo_of)
+    before = set(glob.glob(os.path.join(conf.nfs, "query.*")))
+    f0 = _counter("rpc_frames_sent_total")
+    outdir = str(tmp_path / "artifacts")
+    rc = pq.main(["-c", conf_path, "--backend", "host", "-o", outdir])
+    assert rc == pq.EXIT_CLEAN
+    assert os.path.exists(os.path.join(outdir, "parts.csv"))
+    after = set(glob.glob(os.path.join(conf.nfs, "query.*")))
+    assert after <= before, f"rpc campaign wrote query files: " \
+        f"{sorted(after - before)}"
+    assert _counter("rpc_frames_sent_total") - f0 >= 2 * N_WORKERS
+
+
+def test_supervisor_spawns_rpc_endpoint(rpc_world, tmp_path,
+                                        monkeypatch):
+    conf, g, dc, queries = rpc_world
+    conf_path = str(tmp_path / "conf.json")
+    conf.save(conf_path)
+    spawned = {}
+
+    class _FakeProc:
+        def poll(self):
+            return None
+
+    def fake_popen(cmd, **kw):
+        spawned["cmd"] = cmd
+        return _FakeProc()
+
+    monkeypatch.setattr(sup_mod.subprocess, "Popen", fake_popen)
+    rpc_dir = str(tmp_path / "socks")
+    sup = sup_mod.WorkerSupervisor(conf, conf_path,
+                                   fifo_dir=str(tmp_path),
+                                   rpc_dir=rpc_dir)
+    sup._spawn_server(sup.workers[0])
+    assert "--rpc-socket" in spawned["cmd"]
+    idx = spawned["cmd"].index("--rpc-socket")
+    assert spawned["cmd"][idx + 1] == os.path.join(
+        rpc_dir, "dos-rpc-worker0.sock")
+    # default fleet (DOS_TRANSPORT unset, no rpc_dir): no endpoint flag
+    monkeypatch.delenv("DOS_TRANSPORT", raising=False)
+    sup2 = sup_mod.WorkerSupervisor(conf, conf_path,
+                                    fifo_dir=str(tmp_path))
+    sup2._spawn_server(sup2.workers[0])
+    assert "--rpc-socket" not in spawned["cmd"]
+
+
+# ------------------------------------------------------- obs satellites
+
+def test_statusz_transport_sections(rpc_fleet):
+    conf, g, dc, queries, fleet = rpc_fleet
+    faults.reset()
+    wstat = fleet.servers[1].statusz()
+    assert wstat["transport"]["credit"] >= 1
+    assert "connections" in wstat["transport"]
+    disp = RpcDispatcher(conf, timeout=30.0)
+    fe = _frontend(dc, disp)
+    fe.start()
+    try:
+        mine = queries[dc.worker_of(queries[:, 1]) == 1][:2]
+        assert fe.query(int(mine[0][0]), int(mine[0][1]),
+                        timeout=30).ok
+        tstat = fe.statusz()["transport"]
+        assert tstat["mode"] == "rpc"
+        assert tstat["connections"]["1"]["connected"] is True
+    finally:
+        fe.stop()
+
+
+def test_top_renders_transport_blank_tolerantly():
+    from distributed_oracle_search_tpu.obs import fleet as obs_fleet
+
+    # worker-style section
+    row = obs_fleet._summarize(
+        {"worker": {"transport": {"connections": 2, "inflight": 1,
+                                  "credit": 8}}})
+    assert (row["conns"], row["inflight"], row["credit"]) == (2, 1, 8)
+    # head-style per-worker connection table
+    row = obs_fleet._summarize(
+        {"serving": {"transport": {
+            "mode": "rpc",
+            "connections": {"0": {"inflight": 3}, "1": {"inflight": 1}},
+        }}})
+    assert (row["conns"], row["inflight"]) == (2, 4)
+    # pre-RPC endpoints: no section (or garbage) -> blanks, no crash
+    assert "conns" not in obs_fleet._summarize({"worker": {"batches": 1}})
+    assert "conns" not in obs_fleet._summarize(
+        {"worker": {"transport": "garbage"}})
+    table = obs_fleet.render_top({
+        "new": {"worker": {"transport": {"connections": 1,
+                                         "inflight": 0, "credit": 8}}},
+        "old": {"worker": {"batches": 3}},
+    })
+    lines = table.splitlines()
+    assert "conns" in lines[0]
+    assert "-" in lines[-1] or "-" in lines[-2]
+
+
+def test_bench_diff_directions_cover_transport_family():
+    from distributed_oracle_search_tpu.obs import fleet as obs_fleet
+
+    for key in ("serve_rpc_vs_fifo_dispatch_ratio",
+                "serve_rpc_queries_per_sec",
+                "serve_fifo_queries_per_sec"):
+        assert obs_fleet._KEY_DIRECTIONS[key] == "higher", key
+    for key in ("serve_rpc_dispatch_ms", "serve_fifo_dispatch_ms",
+                "serve_rpc_p99_ms", "serve_fifo_p99_ms"):
+        assert obs_fleet._KEY_DIRECTIONS[key] == "lower", key
+    assert obs_fleet._KEY_TOLERANCES[
+        "serve_rpc_vs_fifo_dispatch_ratio"] == 0.5
+
+
+def test_rpc_metrics_registered_in_obs_map():
+    import distributed_oracle_search_tpu.obs as obs
+
+    for name in ("rpc_frames_sent_total", "rpc_frames_received_total",
+                 "rpc_frames_torn_total", "rpc_connects_total",
+                 "rpc_reconnects_total", "rpc_transport_errors_total",
+                 "rpc_busy_frames_total", "rpc_heartbeats_total",
+                 "rpc_dispatch_seconds", "rpc_server_connections",
+                 "rpc_server_batches_total",
+                 "rpc_server_replies_dropped_total",
+                 "rpc_server_frames_malformed_total",
+                 "serve_hedge_qfile_reused_total"):
+        assert name in obs.__doc__, name
