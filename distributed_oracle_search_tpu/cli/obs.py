@@ -21,6 +21,15 @@ produces (the merge/compare logic lives in :mod:`..obs.fleet`):
   ``BENCH_r*.json`` against the previous one with per-key tolerances
   (``--tolerance``, ``--key-tolerance key=frac``) and exit non-zero on
   regression — the bench trajectory as a CI gate instead of a log.
+* ``dos-obs slo --endpoint host:port`` — fetch the head's ``/slo``
+  burn-rate page and render each spec's fast/slow burn + alert state;
+  exits non-zero while any spec is alerting (scriptable as a deploy
+  gate).
+* ``dos-obs record --dir TAPE`` — summarize a flight-recorder ring
+  (segments, records, time span).
+* ``dos-obs replay --dir TAPE [--trace DIR...]`` — reconstruct the
+  incident timeline from the tape (events + ticks, optionally merged
+  with Perfetto spans by trace id) in timestamp order.
 """
 
 from __future__ import annotations
@@ -91,6 +100,30 @@ def build_parser() -> argparse.ArgumentParser:
     bd.add_argument("--waive-reason", default="",
                     help="why the waived regression is accepted "
                          "(recorded alongside --waive)")
+
+    sl = sub.add_parser("slo", help="burn-rate page from the head's "
+                                    "/slo endpoint")
+    sl.add_argument("--endpoint", required=True, help="host:port")
+    sl.add_argument("--watch", type=float, default=0.0,
+                    help="refresh every N seconds (0 = once)")
+    sl.add_argument("--timeout", type=float, default=3.0)
+
+    rc = sub.add_parser("record", help="summarize a flight-recorder "
+                                       "tape directory")
+    rc.add_argument("--dir", required=True, help="tape directory")
+
+    rp = sub.add_parser("replay", help="reconstruct an incident "
+                                       "timeline from a tape")
+    rp.add_argument("--dir", required=True, help="tape directory")
+    rp.add_argument("--since", type=float, default=None,
+                    help="drop records before this unix timestamp")
+    rp.add_argument("--until", type=float, default=None,
+                    help="drop records after this unix timestamp")
+    rp.add_argument("--trace", action="append", default=[],
+                    help="Perfetto trace file/dir to merge spans from "
+                         "by trace id (repeatable)")
+    rp.add_argument("--events-only", action="store_true",
+                    help="hide telemetry ticks, show events only")
     return p
 
 
@@ -212,13 +245,93 @@ def _cmd_bench_diff(args) -> int:
     return 0
 
 
+def _render_slo(payload: dict) -> tuple[str, bool]:
+    """The ``/slo`` payload as a table; second value = any alert."""
+    if "error" in payload and not any(
+            isinstance(v, dict) for v in payload.values()):
+        return f"slo: {payload['error']}", False
+    hdr = (f"{'spec':24s} {'kind':12s} {'objective':>9s} "
+           f"{'fast burn':>9s} {'slow burn':>9s}  state")
+    lines = [hdr, "-" * len(hdr)]
+    alerting = False
+    for name, s in sorted(payload.items()):
+        if not isinstance(s, dict):
+            continue
+
+        def _b(v):
+            return f"{v:9.2f}" if isinstance(v, (int, float)) else (
+                " " * 8 + "-")
+
+        state = "ALERT" if s.get("alerting") else "ok"
+        alerting = alerting or bool(s.get("alerting"))
+        lines.append(
+            f"{name:24s} {str(s.get('kind', '')):12s} "
+            f"{s.get('objective', 0):9.4f} {_b(s.get('fast_burn'))} "
+            f"{_b(s.get('slow_burn'))}  {state}")
+    return "\n".join(lines), alerting
+
+
+def _cmd_slo(args) -> int:
+    try:
+        while True:
+            table, alerting = _render_slo(
+                fleet.fetch_json(args.endpoint, "/slo",
+                                 timeout_s=args.timeout))
+            print(table)
+            if args.watch <= 0:
+                # scriptable: a deploy gate can `dos-obs slo && push`
+                return 1 if alerting else 0
+            time.sleep(args.watch)
+            print()
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_record(args) -> int:
+    from ..obs import recorder as obs_recorder
+
+    records = obs_recorder.replay(args.dir)
+    segments = obs_recorder.segment_paths(args.dir)
+    events = [r for r in records if r.get("rec") == "event"]
+    ticks = [r for r in records if r.get("rec") == "tick"]
+    print(f"tape {args.dir}: {len(segments)} segment(s), "
+          f"{len(records)} record(s) ({len(events)} event(s), "
+          f"{len(ticks)} tick(s))")
+    if records:
+        t0, t1 = records[0]["ts"], records[-1]["ts"]
+        print(f"  span: {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(t0))}"
+              f" .. {time.strftime('%H:%M:%S', time.localtime(t1))} "
+              f"({t1 - t0:.1f}s)")
+    kinds = {}
+    for r in events:
+        kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
+    for kind, n in sorted(kinds.items()):
+        print(f"  {kind:20s} {n}")
+    return 0
+
+
+def _cmd_replay(args) -> int:
+    from ..obs import recorder as obs_recorder
+
+    records = obs_recorder.replay(args.dir, since=args.since,
+                                  until=args.until)
+    if args.events_only:
+        records = [r for r in records if r.get("rec") != "tick"]
+    print(obs_recorder.render_timeline(records,
+                                       trace_paths=args.trace or None))
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     set_verbosity(args.verbose)
     return {"merge-metrics": _cmd_merge_metrics,
             "merge-traces": _cmd_merge_traces,
             "top": _cmd_top,
-            "bench-diff": _cmd_bench_diff}[args.cmd](args)
+            "bench-diff": _cmd_bench_diff,
+            "slo": _cmd_slo,
+            "record": _cmd_record,
+            "replay": _cmd_replay}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -103,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve live /metrics /healthz /statusz on this "
                         "port (0 = OS-assigned ephemeral; default off; "
                         "DOS_OBS_PORT env)")
+    p.add_argument("--recorder-dir", default=None,
+                   help="flight-recorder tape directory: keep a bounded "
+                        "on-disk ring of telemetry ticks + structured "
+                        "events for dos-obs replay (DOS_RECORDER_DIR "
+                        "env; default off)")
     return p
 
 
@@ -276,6 +281,7 @@ def main(argv=None) -> int:
     frontend, registry, families = build_frontend(conf, args)
     frontend.start()
     obs_srv = None
+    head_pub = poller = slo_engine = recorder = None
     # graceful drain: SIGTERM (the orchestrator's stop signal) and
     # SIGINT both stop ingress — the event ends the socket/tail loops,
     # the exception unwinds a blocking stdin read — then the finally
@@ -302,7 +308,37 @@ def main(argv=None) -> int:
         # bind failure (port taken) must drain the started frontend,
         # not leave its batcher threads running behind a traceback
         from ..obs import device as obs_device
+        from ..obs import recorder as obs_recorder
+        from ..obs import slo as obs_slo
+        from ..obs import telemetry as obs_telemetry
+        from ..obs import timeseries as obs_timeseries
         from ..obs.http import start_obs_server
+        from ..utils.env import env_str
+        # the fleet telemetry plane: workers push ticks here (telemetry
+        # frames on the RPC lane, polled .telemetry sidecars on the
+        # FIFO lane), the head publishes its OWN serve-side windows and
+        # shed counters into the same store, and the SLO engine burns
+        # budgets against the merged view. All of it optional: with
+        # DOS_TELEMETRY_INTERVAL_S=0 the serve runs exactly as before.
+        store = obs_timeseries.TimeseriesStore()
+        recorder = None
+        rec_dir = args.recorder_dir or env_str("DOS_RECORDER_DIR")
+        if rec_dir:
+            recorder = obs_recorder.FlightRecorder(rec_dir)
+            obs_recorder.set_recorder(recorder)
+        tele_ingest = obs_telemetry.TelemetryIngest(store,
+                                                    recorder=recorder)
+        rpc_transport.set_telemetry_sink(tele_ingest.ingest)
+        poller = None
+        if args.backend == "host":
+            poller = obs_telemetry.SidecarPoller(
+                os.path.dirname(command_fifo_path(0)) or ".",
+                tele_ingest).start()
+        head_pub = None
+        if obs_telemetry.interval_s() > 0:
+            head_pub = obs_telemetry.TelemetryPublisher(
+                source="head", sinks=[tele_ingest.ingest]).start()
+        slo_engine = obs_slo.SLOEngine(store).start()
         obs_srv = start_obs_server(
             args.obs_port,
             health_fn=lambda: {
@@ -311,7 +347,10 @@ def main(argv=None) -> int:
             status_providers={
                 "serving": frontend.statusz,
                 "device_programs": obs_device.snapshot,
-            })
+                "telemetry": tele_ingest.statusz,
+                "slo": slo_engine.statusz,
+            },
+            slo_provider=slo_engine.payload)
         if args.ingress == "stdin":
             n = ingress.serve_stdin(frontend, families=families)
         elif args.ingress == "socket":
@@ -332,6 +371,16 @@ def main(argv=None) -> int:
         frontend.stop()
         if obs_srv is not None:
             obs_srv.close()
+        # telemetry plane teardown: stop the loops, detach the global
+        # sinks (they outlive main() otherwise), seal the tape durably
+        rpc_transport.set_telemetry_sink(None)
+        for t in (head_pub, poller, slo_engine):
+            if t is not None:
+                t.stop()
+        if recorder is not None:
+            from ..obs import recorder as obs_recorder
+            obs_recorder.set_recorder(None)
+            recorder.close()
         if registry is not None:
             registry.shutdown()
         if args.metrics_dump:

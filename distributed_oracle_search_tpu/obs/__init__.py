@@ -295,6 +295,52 @@ compressed in device memory and decompressed only at the point of use,
   from a compressed-resident shard (the Pallas kernel's
   decompress-on-tile path or the XLA run-start decode feeding either
   kernel).
+
+Fleet telemetry bus (``obs.telemetry`` + ``obs.timeseries`` — workers
+push delta-encoded metric snapshots to the head over the RPC wire or
+the FIFO lane's ``.telemetry`` sidecar, ``DOS_TELEMETRY_INTERVAL_S``;
+README "Fleet telemetry & SLOs"):
+
+* publisher — ``telemetry_ticks_published_total`` (snapshots emitted
+  on the cadence), ``telemetry_publish_errors_total`` (sinks that
+  raised; per-sink, the tick still reaches the others),
+  ``telemetry_publish_seconds`` (one tick build+fan-out — the bench's
+  publish-overhead numerator), ``rpc_heartbeat_seconds`` window
+  (heartbeat round-trips per connection, plus the per-worker
+  ``rpc_heartbeat_seconds_w<wid>`` twins);
+* head ingest — ``telemetry_ticks_ingested_total`` /
+  ``telemetry_ticks_dropped_total`` (undecodable or wrong-shape
+  ticks), ``telemetry_counter_resets_total`` (source restarts
+  detected by incarnation change or counter regression — deltas clamp
+  to absolute-from-zero, never negative);
+* timeseries store (byte-budgeted ring, ``DOS_TELEMETRY_BYTES``) —
+  ``telemetry_points_total`` (points appended),
+  ``telemetry_series_evicted_total`` (rings dropped by the budget,
+  oldest-written first), ``telemetry_series`` / ``telemetry_store_bytes``
+  (gauges: live ring count and retained bytes).
+
+SLO burn-rate engine (``obs.slo`` — declarative objectives evaluated
+as multi-window burn rates with hysteresis, ``DOS_SLO_SPECS``; the
+``/slo`` endpoint and ``dos-obs slo``):
+
+* ``slo_evaluations_total`` / ``slo_alerts_total`` (evaluation passes,
+  and alerts that TRIPPED — clears don't count);
+* per-objective gauges ``slo_fast_burn_<name>`` / ``slo_slow_burn_<name>``
+  (burn = bad-fraction / error-budget over the fast/slow windows) and
+  ``slo_alerting_<name>`` (1 while tripped; hysteresis clears at half
+  the trip threshold).
+
+Black-box flight recorder (``obs.recorder`` — bounded on-disk ring of
+telemetry ticks + structured events, ``DOS_RECORDER_DIR``; ``dos-obs
+record`` / ``dos-obs replay``):
+
+* ``recorder_events_total`` (structured events emitted fleet-wide:
+  epoch swaps, breaker transitions, respawns, membership commits,
+  BUSY storms, fault injections, SLO alerts/clears),
+  ``recorder_records_total`` (records written to the tape),
+  ``recorder_segments_total`` (segment rotations),
+  ``recorder_torn_lines_total`` (torn tail lines skipped at replay),
+  ``recorder_ring_bytes`` (gauge: on-disk ring footprint).
 """
 
 from . import device, fleet, metrics, quantiles, trace
@@ -302,6 +348,20 @@ from .metrics import REGISTRY, counter, gauge, histogram
 from .quantiles import WINDOWS
 from .trace import span
 
-__all__ = ["device", "fleet", "metrics", "quantiles", "trace",
+#: imported lazily (PEP 562): these modules use ``utils.atomicio``,
+#: which itself registers metrics — an eager import here would close
+#: an import cycle through the package __init__
+_LAZY = ("recorder", "slo", "telemetry", "timeseries")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")
+
+__all__ = ["device", "fleet", "metrics", "quantiles", "recorder",
+           "slo", "telemetry", "timeseries", "trace",
            "REGISTRY", "WINDOWS", "counter", "gauge", "histogram",
            "span"]

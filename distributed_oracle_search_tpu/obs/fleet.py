@@ -154,17 +154,22 @@ def merge_traces(inputs: list[str], out_path: str) -> int:
 
 # --------------------------------------------------------------- statusz
 
-def fetch_statusz(endpoint: str, timeout_s: float = 3.0) -> dict:
-    """``host:port`` -> its ``/statusz`` JSON (``{"error": ...}`` when
+def fetch_json(endpoint: str, path: str = "/statusz",
+               timeout_s: float = 3.0) -> dict:
+    """``host:port`` + path -> its JSON (``{"error": ...}`` when
     unreachable — a dead worker is a row in the fleet table, not a
     crash of the tool watching for dead workers)."""
     url = endpoint if "://" in endpoint else f"http://{endpoint}"
     try:
-        with urllib.request.urlopen(f"{url}/statusz",
+        with urllib.request.urlopen(f"{url}{path}",
                                     timeout=timeout_s) as r:
             return json.loads(r.read().decode())
     except Exception as e:  # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+def fetch_statusz(endpoint: str, timeout_s: float = 3.0) -> dict:
+    return fetch_json(endpoint, "/statusz", timeout_s=timeout_s)
 
 
 def _summarize(status: dict) -> dict:
@@ -269,6 +274,34 @@ def _summarize(status: dict) -> dict:
                 and not isinstance(credit, bool):
             out["credit"] = int(credit)
         break
+    # SLO / telemetry columns (the head's fleet-health plane): worst
+    # fast-burn across objectives (the page-now signal) and worst
+    # telemetry source lag (a stalled publisher or dead wire shows up
+    # as lag before anything else does). Pre-telemetry endpoints omit
+    # both sections and their rows show "-" blanks, never a crash
+    slo_sec = status.get("slo")
+    if isinstance(slo_sec, dict):
+        burn_sec = slo_sec.get("burn")
+        burns = [_num(b.get("fast"), None)
+                 for b in (burn_sec.values()
+                           if isinstance(burn_sec, dict) else ())
+                 if isinstance(b, dict)]
+        burns = [b for b in burns if b is not None]
+        if burns:
+            out["slo burn"] = round(max(burns), 2)
+        alerting = slo_sec.get("alerting")
+        if isinstance(alerting, list) and alerting:
+            out["state"] = "SLO:" + ",".join(str(a) for a in alerting)
+    tele = status.get("telemetry")
+    if isinstance(tele, dict):
+        src_sec = tele.get("sources")
+        lags = [_num(s.get("lag_s"), None)
+                for s in (src_sec.values()
+                          if isinstance(src_sec, dict) else ())
+                if isinstance(s, dict)]
+        lags = [v for v in lags if v is not None]
+        if lags:
+            out["tel lag"] = round(max(lags), 1)
     mig = serving.get("migration") or worker.get("migration")
     if isinstance(mig, dict):
         moves = mig.get("moves") if isinstance(mig.get("moves"), list) \
@@ -375,6 +408,15 @@ _KEY_DIRECTIONS = {
     "serve_fifo_p99_ms": "lower",
     "serve_rpc_queries_per_sec": "higher",
     "serve_fifo_queries_per_sec": "higher",
+    # the telemetry family (fleet telemetry bus, PR 16): the head's
+    # ingest rate improves UP; the publish tail and the overhead
+    # fraction (mean tick build time / publish interval — the "< 1%
+    # of serve throughput" acceptance) improve DOWN (the p99_ms suffix
+    # would catch the first — listed so the family's contract is in
+    # one place like the others)
+    "telemetry_head_ingest_per_sec": "higher",
+    "telemetry_publish_p99_ms": "lower",
+    "telemetry_publish_overhead_frac": "lower",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -404,6 +446,14 @@ _KEY_TOLERANCES = {
     # cost swings with host load — gate it loosely (a real regression
     # to ~1 still trips)
     "serve_rpc_vs_fifo_dispatch_ratio": 0.5,
+    # tick build cost is microseconds measured against host jitter —
+    # the p99 and the derived overhead fraction both swing with host
+    # load on the shared device, so gate them loosely (a real
+    # regression — publish cost approaching the interval — still
+    # trips); the ingest rate is in-process dict work, same story
+    "telemetry_publish_p99_ms": 0.5,
+    "telemetry_publish_overhead_frac": 0.5,
+    "telemetry_head_ingest_per_sec": 0.5,
 }
 
 

@@ -75,11 +75,14 @@ class ObsServer:
                  status_providers: dict | None = None,
                  registry: obs_metrics.MetricsRegistry | None = None,
                  windows: obs_quantiles.QuantileWindows | None = None,
-                 host: str | None = None):
+                 host: str | None = None, slo_provider=None):
         self.registry = registry or obs_metrics.REGISTRY
         self.windows = windows or obs_quantiles.WINDOWS
         self.health_fn = health_fn
         self.status_providers = dict(status_providers or {})
+        #: zero-arg callable returning the ``/slo`` JSON payload (the
+        #: SLO engine's fresh evaluation); absent = 404, pre-SLO shape
+        self.slo_provider = slo_provider
         if host is None:
             # loopback by default: the endpoints are unauthenticated
             # and /statusz names FIFO paths and topology — widening to
@@ -97,8 +100,9 @@ class ObsServer:
     # --------------------------------------------------------- lifecycle
     def start(self) -> "ObsServer":
         self._thread.start()
-        log.info("obs endpoints up on :%d (/metrics /healthz /statusz)",
-                 self.port)
+        log.info("obs endpoints up on :%d (/metrics /healthz /statusz%s)",
+                 self.port,
+                 " /slo" if self.slo_provider is not None else "")
         return self
 
     def close(self) -> None:
@@ -125,6 +129,13 @@ class ObsServer:
         except Exception as e:  # noqa: BLE001 — a health-provider bug
             # must surface as unhealthy, never as a scrape crash
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def slo(self) -> dict:
+        try:
+            return dict(self.slo_provider())
+        except Exception as e:  # noqa: BLE001 — a burn-eval bug must
+            # not take down the page the operator is paged ON
+            return {"error": f"{type(e).__name__}: {e}"}
 
     def statusz(self) -> dict:
         out = {}
@@ -170,6 +181,13 @@ class ObsServer:
                         self._send(
                             200,
                             (json.dumps(server.statusz(), indent=1,
+                                        default=str) + "\n").encode(),
+                            "application/json")
+                    elif (path == "/slo"
+                          and server.slo_provider is not None):
+                        self._send(
+                            200,
+                            (json.dumps(server.slo(), indent=1,
                                         default=str) + "\n").encode(),
                             "application/json")
                     else:

@@ -280,6 +280,27 @@ def _lane_walk_fn(mesh: Mesh, max_steps: int, k_moves: int,
     return jax.jit(sm)
 
 
+def lane_walk_program(dg: DeviceGraph, fm, t_rows, s, t, valid, w_pad,
+                      mesh: Mesh, k_moves: int = -1,
+                      max_steps: int = 0, kernel: str = "xla"):
+    """``(jitted_fn, operands)`` of one lane-split walk call — the same
+    cached jit :func:`walk_lanes` dispatches, with the flat ``[Q]``
+    query arrays reshaped to ``[L, Q/L]`` and lane-sharded exactly as
+    it ships them. Split out so the engine's AOT cost capture lowers
+    the program the mesh path ACTUALLY ran (an XLA cache hit), instead
+    of going dark under lanes."""
+    lanes = mesh.shape[LANE_AXIS]
+    q = int(np.asarray(s).shape[0])
+    qs = NamedSharding(mesh, P(LANE_AXIS, None))
+    packed = tuple(np.asarray(a).reshape(lanes, q // lanes)
+                   for a in (t_rows, s, t, valid))
+    # ONE device_put for the whole pack (same rationale as
+    # query_sharded: each separate transfer pays a fixed round trip)
+    args = jax.device_put(packed, qs)
+    fn = _lane_walk_fn(mesh, max_steps, int(k_moves), str(kernel))
+    return fn, (dg, fm, *args, w_pad)
+
+
 def walk_lanes(dg: DeviceGraph, fm, t_rows, s, t, valid, w_pad,
                mesh: Mesh, k_moves: int = -1, max_steps: int = 0,
                kernel: str = "xla"):
@@ -293,16 +314,11 @@ def walk_lanes(dg: DeviceGraph, fm, t_rows, s, t, valid, w_pad,
     single-device kernel does, and results are bucket-invariant
     (pinned), hence bit-identical after the flat reshape back.
     Returns ``(cost, plen, finished)`` flat ``[Q]`` device arrays."""
-    lanes = mesh.shape[LANE_AXIS]
     q = int(np.asarray(s).shape[0])
-    qs = NamedSharding(mesh, P(LANE_AXIS, None))
-    packed = tuple(np.asarray(a).reshape(lanes, q // lanes)
-                   for a in (t_rows, s, t, valid))
-    # ONE device_put for the whole pack (same rationale as
-    # query_sharded: each separate transfer pays a fixed round trip)
-    args = jax.device_put(packed, qs)
-    fn = _lane_walk_fn(mesh, max_steps, int(k_moves), str(kernel))
-    cost, plen, fin = fn(dg, fm, *args, w_pad)
+    fn, ops = lane_walk_program(dg, fm, t_rows, s, t, valid, w_pad,
+                                mesh, k_moves=k_moves,
+                                max_steps=max_steps, kernel=kernel)
+    cost, plen, fin = fn(*ops)
     return cost.reshape(q), plen.reshape(q), fin.reshape(q)
 
 

@@ -32,6 +32,7 @@ import numpy as np
 
 from ..obs import metrics as obs_metrics
 from ..obs import quantiles as obs_quantiles
+from ..obs import recorder as obs_recorder
 from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
 from ..transport import resilience
@@ -393,6 +394,8 @@ class ServingFrontend:
                  "dropped (%s), %d re-keyed survivors, %d edge(s) "
                  "affected", old_epoch, epoch, dropped, reason, kept,
                  len(affected))
+        obs_recorder.emit("epoch_swap", old=old_epoch, new=epoch,
+                          dropped=dropped, kept=kept)
 
     def set_diff(self, diff: str) -> None:
         """Switch the active congestion diff. The cache is invalidated

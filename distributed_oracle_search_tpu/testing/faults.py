@@ -186,6 +186,11 @@ class FaultInjector:
                 M_INJECTED.inc()
                 log.warning("fault injected: %s (rule %d, wid=%s)",
                             point, rule.index, wid)
+                # the black box gets every injection: a chaos drill's
+                # timeline starts at this record (import here — the
+                # fault layer must stay importable before obs wiring)
+                from ..obs import recorder as obs_recorder
+                obs_recorder.emit("fault", point=point, wid=wid)
                 return rule
         return None
 

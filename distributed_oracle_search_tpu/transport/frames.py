@@ -42,6 +42,10 @@ problem to skip, same tolerance rule):
             — the client books BUSY instead of discovering a timeout
 ``ping``    liveness probe (the ``__DOS_PING__`` vocabulary on sockets)
 ``health``  the answer to ``ping``: ``status`` = HealthStatus dict
+``telemetry``  server -> client push, no ``id``, no reply: ``tick`` =
+            one telemetry snapshot (its OWN schema version inside —
+            see ``obs.telemetry``); a client that predates it drops
+            the frame as unmatched, by the unknown-kind rule
 """
 
 from __future__ import annotations

@@ -30,6 +30,7 @@ import threading
 import time
 
 from ..obs import metrics as obs_metrics
+from ..obs import recorder as obs_recorder
 from ..utils.env import env_cast, env_flag
 from ..utils.locks import OrderedLock
 from ..utils.log import get_logger
@@ -74,52 +75,74 @@ class CircuitBreaker:
 
     def allow(self) -> bool:
         """May the caller send a batch to this worker right now?"""
-        with self._lock:
-            if self.state == CLOSED:
-                return True
-            if self.state == OPEN:
-                # cooldown fallback: without a probe loop the breaker
-                # still half-opens on its own after cooldown_s
-                if self.clock() - self.opened_at >= self.cooldown_s:
-                    self._to_half_open_locked("cooldown")
-                else:
+        # the transition event fires in the finally, AFTER the lock is
+        # released: the recorder bus takes its own lock and must never
+        # nest inside the breaker's
+        ev = None
+        try:
+            with self._lock:
+                if self.state == CLOSED:
+                    return True
+                if self.state == OPEN:
+                    # cooldown fallback: without a probe loop the breaker
+                    # still half-opens on its own after cooldown_s
+                    if self.clock() - self.opened_at >= self.cooldown_s:
+                        self._to_half_open_locked("cooldown")
+                        ev = ("breaker_half_open", "cooldown")
+                    else:
+                        M_REJECTED.inc()
+                        return False
+                # HALF_OPEN: exactly one trial at a time
+                if self._trial_in_flight:
                     M_REJECTED.inc()
                     return False
-            # HALF_OPEN: exactly one trial at a time
-            if self._trial_in_flight:
-                M_REJECTED.inc()
-                return False
-            self._trial_in_flight = True
-            return True
+                self._trial_in_flight = True
+                return True
+        finally:
+            if ev is not None:
+                obs_recorder.emit(ev[0], key=str(self.key), why=ev[1])
 
     def record(self, ok: bool) -> None:
-        with self._lock:
-            trial = self._trial_in_flight
-            self._trial_in_flight = False
-            if ok:
-                self.consecutive_failures = 0
-                if self.state != CLOSED:
-                    log.info("circuit for %s CLOSED (good %s)", self.key,
-                             "trial" if trial else "send")
-                    self.state = CLOSED
-                    M_CLOSED.inc()
-                    G_OPEN.add(-1)
-                return
-            self.consecutive_failures += 1
-            if self.state == HALF_OPEN:
-                log.warning("circuit for %s trial failed; re-OPEN",
-                            self.key)
-                self.state = OPEN
-                self.opened_at = self.clock()
-                M_OPENED.inc()
-            elif (self.state == CLOSED
-                  and self.consecutive_failures >= self.threshold):
-                log.error("circuit for %s OPEN after %d consecutive "
-                          "failures", self.key, self.consecutive_failures)
-                self.state = OPEN
-                self.opened_at = self.clock()
-                M_OPENED.inc()
-                G_OPEN.add(1)
+        ev = None
+        try:
+            with self._lock:
+                trial = self._trial_in_flight
+                self._trial_in_flight = False
+                if ok:
+                    self.consecutive_failures = 0
+                    if self.state != CLOSED:
+                        log.info("circuit for %s CLOSED (good %s)",
+                                 self.key, "trial" if trial else "send")
+                        self.state = CLOSED
+                        M_CLOSED.inc()
+                        G_OPEN.add(-1)
+                        ev = ("breaker_close",
+                              "trial" if trial else "send")
+                    return
+                self.consecutive_failures += 1
+                if self.state == HALF_OPEN:
+                    log.warning("circuit for %s trial failed; re-OPEN",
+                                self.key)
+                    self.state = OPEN
+                    self.opened_at = self.clock()
+                    M_OPENED.inc()
+                    ev = ("breaker_open", "trial failed")
+                elif (self.state == CLOSED
+                      and self.consecutive_failures >= self.threshold):
+                    log.error("circuit for %s OPEN after %d consecutive "
+                              "failures", self.key,
+                              self.consecutive_failures)
+                    self.state = OPEN
+                    self.opened_at = self.clock()
+                    M_OPENED.inc()
+                    G_OPEN.add(1)
+                    ev = (
+                        "breaker_open",
+                        f"{self.consecutive_failures} consecutive "
+                        f"failures")
+        finally:
+            if ev is not None:
+                obs_recorder.emit(ev[0], key=str(self.key), why=ev[1])
 
     def would_allow(self) -> bool:
         """Read-only: could a send plausibly be admitted right now?
@@ -133,9 +156,14 @@ class CircuitBreaker:
             return True
 
     def half_open(self, why: str = "probe") -> None:
+        fired = False
         with self._lock:
             if self.state == OPEN:
                 self._to_half_open_locked(why)
+                fired = True
+        if fired:    # outside the breaker lock, like every transition
+            obs_recorder.emit("breaker_half_open", key=str(self.key),
+                              why=why)
 
     def _to_half_open_locked(self, why: str) -> None:
         log.info("circuit for %s HALF_OPEN (%s)", self.key, why)

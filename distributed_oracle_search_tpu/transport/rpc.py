@@ -58,6 +58,7 @@ from .frames import (
 )
 from .wire import HealthStatus, RuntimeConfig, StatsRow
 from ..obs import metrics as obs_metrics
+from ..obs import quantiles as obs_quantiles
 from ..obs import trace as obs_trace
 from ..testing import faults
 from ..utils.env import env_cast, env_str
@@ -99,6 +100,18 @@ def shutdown_close(sock) -> None:
         sock.close()
     except OSError as e:
         log.debug("socket close failed: %s", e)
+
+
+#: head-side sink for pushed ``telemetry`` frames (``obs.telemetry``'s
+#: ingest installs itself here); None = drop, the pre-telemetry behavior
+_telemetry_sink = None
+
+
+def set_telemetry_sink(fn) -> None:
+    """Install (or clear, with None) the callable that receives every
+    pushed telemetry tick from every client's read loop."""
+    global _telemetry_sink
+    _telemetry_sink = fn
 
 
 class RpcBusy(RuntimeError):
@@ -161,8 +174,10 @@ class RpcClient:
 
     def __init__(self, endpoint, timeout_s: float | None = None,
                  max_inflight: int | None = None,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0,
+                 wid: int | None = None):
         self.endpoint = endpoint
+        self.wid = wid          # labels this lane's heartbeat window
         self.timeout_s = (timeout_s if timeout_s is not None
                           else env_cast("DOS_RPC_TIMEOUT_S",
                                         DEFAULT_TIMEOUT, float))
@@ -262,6 +277,17 @@ class RpcClient:
                     raise TransportError("server closed the connection")
                 if fr.kind == "hello":
                     continue            # late/duplicate hello: ignore
+                if fr.kind == "telemetry":
+                    # fire-and-forget push (no id): hand the tick to
+                    # the head's ingest if one is installed, else drop
+                    sink = _telemetry_sink
+                    if sink is not None:
+                        try:
+                            sink(fr.header.get("tick"))
+                        except Exception as e:  # noqa: BLE001 — a bad
+                            # tick must not kill the data-plane reader
+                            log.warning("telemetry sink failed: %s", e)
+                    continue
                 fid = fr.header.get("id")
                 with self._lock:
                     slot = self._pending.get(fid)
@@ -304,8 +330,17 @@ class RpcClient:
                                      connect_timeout_s=min(
                                          interval_s, 10.0))
             try:
+                t0 = time.perf_counter()
                 probe_client.probe(timeout=interval_s)
+                dt = time.perf_counter() - t0
                 M_HEARTBEATS.inc()
+                # the one continuous liveness signal, with latency
+                # history the SLO engine can window (per worker when
+                # the lane knows its wid, plus the fleet aggregate)
+                obs_quantiles.observe("rpc_heartbeat_seconds", dt)
+                if self.wid is not None:
+                    obs_quantiles.observe(
+                        f"rpc_heartbeat_seconds_w{self.wid}", dt)
             except (TransportError, RpcBusy) as e:
                 log.warning("rpc heartbeat to %s failed: %s",
                             endpoint_str(self.endpoint), e)
@@ -424,8 +459,8 @@ def client_for(wid: int, host: str = "localhost") -> RpcClient:
     with _client_cache_lock:
         c = _client_cache.get(key)
         if c is None:
-            c = _client_cache[key] = RpcClient(endpoint_for(wid,
-                                                            host=host))
+            c = _client_cache[key] = RpcClient(
+                endpoint_for(wid, host=host), wid=int(wid))
         return c
 
 

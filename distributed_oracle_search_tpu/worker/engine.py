@@ -746,11 +746,7 @@ class ShardEngine:
             self.last_paths = (nodes, moves)
         t2 = time.perf_counter()
         self._finish_search(jit_key, first_call, nq, t2 - t1)
-        # lane-split batches skip the capture: the AOT analysis below
-        # lowers the SINGLE-DEVICE program, which the mesh path never
-        # ran — capturing it would book a fresh compile of a
-        # never-executed shape (the exact thing the cap_n logic avoids)
-        if first_call and obs_device.enabled() and not self._lane_split:
+        if first_call and obs_device.enabled():
             # one XLA cost/memory analysis per compiled-program key
             # (FLOPs, bytes accessed, HBM footprint -> /metrics gauges +
             # BENCH_DETAIL.json): the AOT re-lower is cheap and runs
@@ -765,7 +761,26 @@ class ShardEngine:
                      if deadline is not None and qpad > self.astar_chunk
                      else qpad)
             sl = slice(0, cap_n)
-            if kernel == "pallas":
+            if self._lane_split:
+                # the mesh path ran the lane-split shard_map program,
+                # not the single-device one — lower THAT (the roofline
+                # gauges used to go dark on meshed workers). The helper
+                # hands back the SAME cached jit walk_lanes dispatched,
+                # with operands lane-sharded exactly as it shipped them,
+                # so the AOT lower/compile is an XLA cache hit; the key
+                # carries the lane count because lane programs compile
+                # per lane count (the jit_key says the same)
+                from ..parallel.sharded import lane_walk_program
+                tag = "[pallas]" if kernel == "pallas" else ""
+                fn_l, ops_l = lane_walk_program(
+                    self.dg, fm_walk, rows[sl], s[sl], t[sl],
+                    valid[sl], w_pad, self.mesh,
+                    k_moves=config.k_moves, kernel=kernel)
+                obs_device.capture(
+                    f"table-search{tag}[lanes{self.n_lanes}]"
+                    f"/q{cap_n}/k{config.k_moves}",
+                    fn_l, *ops_l)
+            elif kernel == "pallas":
                 # the fused kernel's statics live in a closure so the
                 # capture's AOT lower sees only array operands (its
                 # interpret/bucket resolution runs at trace time)

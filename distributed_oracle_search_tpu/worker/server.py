@@ -753,6 +753,11 @@ class FifoServer:
         rpc_loop = getattr(self, "rpc_loop", None)
         if rpc_loop is not None:
             out["transport"] = rpc_loop.statusz()
+        # telemetry column: present only when this worker publishes
+        # ticks (pre-telemetry workers omit it; consumers blank it)
+        publisher = getattr(self, "telemetry", None)
+        if publisher is not None:
+            out["telemetry"] = publisher.statusz()
         try:
             out["build_ledger_blocks"] = len(
                 BuildLedger(self.conf.outdir, self.wid).entries())
@@ -795,6 +800,7 @@ class RpcServeLoop:
         self._listener = None
         self._threads: list = []
         self._conns: list = []
+        self._writers: dict = {}    # sock -> FrameWriter (broadcasts)
         self._stop = threading.Event()
         self._lock = OrderedLock("worker.RpcServeLoop")
         self._inflight = 0
@@ -872,6 +878,8 @@ class RpcServeLoop:
 
         reader = frames.FrameReader(sock)
         writer = frames.FrameWriter(sock)
+        with self._lock:
+            self._writers[sock] = writer    # telemetry broadcast lane
         try:
             writer.send({"kind": "hello", "wid": self.fs.wid,
                          "credit": self.credit})
@@ -899,6 +907,7 @@ class RpcServeLoop:
             shutdown_close(sock)
             me = threading.current_thread()
             with self._lock:
+                self._writers.pop(sock, None)
                 if sock in self._conns:
                     self._conns.remove(sock)
                 # prune this handler from the join list: every breaker
@@ -1049,6 +1058,21 @@ class RpcServeLoop:
             log.warning("rpc reply dropped: %s", e)
             M_RPC_DROPPED.inc()
 
+    def broadcast(self, tick: dict) -> None:
+        """Push one telemetry tick on every live connection — fire and
+        forget, no ``id``, no reply. A dead socket just drops its copy
+        (its conn loop is already on the way out); the FrameWriter lock
+        keeps the push from interleaving with an in-flight reply."""
+        from ..transport import frames
+
+        with self._lock:
+            writers = list(self._writers.values())
+        for w in writers:
+            try:
+                w.send({"kind": "telemetry", "tick": tick})
+            except frames.TransportError as e:
+                log.debug("telemetry broadcast dropped: %s", e)
+
     # ------------------------------------------------------------- status
     def statusz(self) -> dict:
         with self._lock:
@@ -1162,9 +1186,25 @@ def main(argv=None) -> int:
     obs_srv = start_obs_server(
         args.obs_port, health_fn=server.health,
         status_providers={"worker": server.statusz})
+    # fleet telemetry: push this worker's counters/gauges/windows to the
+    # head on the DOS_TELEMETRY_INTERVAL_S cadence — over the RPC lane
+    # when it serves (a `telemetry` frame on every live connection) and
+    # always via the FIFO sidecar file the head polls
+    from ..obs import telemetry as obs_telemetry
+    publisher = None
+    if obs_telemetry.interval_s() > 0:
+        sinks = [obs_telemetry.sidecar_sink(
+            server.command_fifo + obs_telemetry.SIDECAR_SUFFIX)]
+        if rpc_loop is not None:
+            sinks.append(rpc_loop.broadcast)
+        publisher = obs_telemetry.TelemetryPublisher(
+            source=f"w{args.workerid}", sinks=sinks).start()
+        server.telemetry = publisher
     try:
         server.serve_forever()
     finally:
+        if publisher is not None:
+            publisher.stop()
         if rpc_loop is not None:
             rpc_loop.stop()
         if obs_srv is not None:

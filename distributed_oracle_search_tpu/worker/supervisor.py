@@ -38,6 +38,7 @@ import threading
 import time
 
 from ..obs import metrics as obs_metrics
+from ..obs import recorder as obs_recorder
 from ..transport import fifo as fifo_transport
 from ..utils.config import ClusterConfig
 from ..utils.env import env_cast
@@ -473,6 +474,8 @@ class WorkerSupervisor:
             proc.terminate()
             return
         M_RESPAWNS.inc()
+        obs_recorder.emit("respawn", wid=w.wid, pid=proc.pid,
+                          respawn=w.respawns, why=why)
         log.warning("supervisor: respawned worker %d (pid %d, "
                     "respawn #%d)", w.wid, proc.pid, w.respawns)
 
@@ -488,9 +491,12 @@ def supervise_forever(conf: ClusterConfig, conf_path: str,
     fleet — healthz goes 503 the moment any worker is down."""
     from ..obs.http import start_obs_server
 
+    from ..obs import telemetry as obs_telemetry
+
     sup = WorkerSupervisor(conf, conf_path, alg=alg, logdir=logdir,
                            traffic_dir=traffic_dir)
     obs_srv = None
+    publisher = None
     try:
         sup.start()
         # inside the try: a bind failure (port taken) must tear the
@@ -498,6 +504,18 @@ def supervise_forever(conf: ClusterConfig, conf_path: str,
         obs_srv = start_obs_server(
             obs_port, health_fn=sup.health,
             status_providers={"supervisor": sup.statusz})
+        # fleet telemetry: the supervisor's own counters (respawns,
+        # ping failures) ride the sidecar lane beside the workers' —
+        # its file lands in the FIFO directory the head already polls
+        if sup.workers and obs_telemetry.interval_s() > 0:
+            fifo_dir = os.path.dirname(
+                next(iter(sup.workers.values())).fifo) or "."
+            publisher = obs_telemetry.TelemetryPublisher(
+                source="supervisor",
+                sinks=[obs_telemetry.sidecar_sink(os.path.join(
+                    fifo_dir,
+                    "supervisor" + obs_telemetry.SIDECAR_SUFFIX))],
+            ).start()
         print(f"supervising {len(sup.workers)} worker(s); "
               "Ctrl-C to stop")
         while True:
@@ -505,6 +523,8 @@ def supervise_forever(conf: ClusterConfig, conf_path: str,
     except KeyboardInterrupt:
         log.info("supervisor: interrupted; stopping workers")
     finally:
+        if publisher is not None:
+            publisher.stop()
         if obs_srv is not None:
             obs_srv.close()
         sup.stop()
