@@ -2728,6 +2728,223 @@ def main() -> None:
             tfe.stop()
         shutil.rmtree(tdir, ignore_errors=True)
 
+    control_stats = {}
+    if os.environ.get("BENCH_CONTROL", "1") != "0":
+        from distributed_oracle_search_tpu.control import (
+            ControlConfig, ControlDaemon,
+        )
+        from distributed_oracle_search_tpu.data import ensure_synth_dataset
+        from distributed_oracle_search_tpu.data.graph import Graph
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            DispatchError, EngineDispatcher, HedgeConfig, ServeConfig,
+            ServingFrontend,
+        )
+        from distributed_oracle_search_tpu.traffic import DiffEpochManager
+        from distributed_oracle_search_tpu.traffic import (
+            scenarios as cscen,
+        )
+        from distributed_oracle_search_tpu.transport.resilience import (
+            BreakerRegistry,
+        )
+        from distributed_oracle_search_tpu.transport.wire import (
+            HealthStatus, RuntimeConfig,
+        )
+        from distributed_oracle_search_tpu.utils.config import (
+            ClusterConfig,
+        )
+        from distributed_oracle_search_tpu.worker.supervisor import (
+            WorkerSupervisor,
+        )
+
+        log("closed-loop control (rush-hour + worker kill, policy on "
+            "vs off)...")
+        cdir = tempfile.mkdtemp(prefix="bench-control-")
+        cpaths = ensure_synth_dataset(cdir, width=20, height=15,
+                                      n_queries=256, seed=47)
+        cconf = ClusterConfig(
+            workers=["localhost"] * 2, partmethod="mod", partkey=2,
+            outdir=os.path.join(cdir, "index"),
+            xy_file=cpaths["xy"], scenfile=cpaths["scen"],
+            nfs=cdir).validate()
+        cg = Graph.from_xy(cconf.xy_file)
+        cdc = DistributionController("mod", 2, 2, cg.n)
+        for wid in range(2):
+            build_worker_shard(cg, cdc, wid, cconf.outdir)
+        write_index_manifest(cconf.outdir, cdc)
+        cn = int(os.environ.get("BENCH_CONTROL_REQUESTS", 1200))
+        crng = np.random.default_rng(47)
+        cpool = cscen.zipf_queries(cg.n, cn, seed=47)
+        ctrace = cscen.rush_hour_trace(cg, epochs=2, frac=0.02,
+                                       peak=3.0, seed=47)
+
+        class _ThreadProc:
+            """Popen shape over an in-process worker slot, so the real
+            WorkerSupervisor (and its kick/backoff machinery) can
+            supervise the incident without subprocess costs."""
+
+            _next_pid = [1]
+
+            def __init__(self):
+                self.dead = False
+                self.returncode = None
+                self.pid = 90_000 + self._next_pid[0]
+                self._next_pid[0] += 1
+
+            def poll(self):
+                if self.dead:
+                    self.returncode = 0
+                    return 0
+                return None
+
+            def wait(self, timeout=None):
+                if self.dead:
+                    return 0
+                raise subprocess.TimeoutExpired("threadproc",
+                                                timeout or 0)
+
+            def terminate(self):
+                self.dead = True
+
+            def kill(self):
+                self.dead = True
+
+        class _GatedDispatch:
+            """EngineDispatcher behind a per-worker liveness gate: a
+            dead worker's sends hang (the dead-FIFO analog) until a
+            send-timeout, so the un-policed fleet pays the realistic
+            price for routing at a corpse."""
+
+            def __init__(self, inner, alive, hang_s=0.6):
+                self.inner = inner
+                self.alive = alive
+                self.hang_s = hang_s
+
+            def answer_batch(self, wid, q, rconf, diff, via=None):
+                w = wid if via is None else via
+                if not self.alive.get(w, True):
+                    deadline = time.monotonic() + self.hang_s
+                    while not self.alive.get(w, True):
+                        if time.monotonic() >= deadline:
+                            raise DispatchError(
+                                f"worker {w} unreachable")
+                        time.sleep(0.01)
+                return self.inner.answer_batch(wid, q, rconf, diff,
+                                               via=via)
+
+        def _control_run(policy_on):
+            alive = {0: True, 1: True}
+            procs = {}
+
+            def spawn(w):
+                alive[w.wid] = True
+                procs[w.wid] = _ThreadProc()
+                return procs[w.wid]
+
+            def probe(w):
+                if alive.get(w.wid) and not w.proc.dead:
+                    return HealthStatus(ok=True, wid=w.wid)
+                return None
+
+            sup = WorkerSupervisor(cconf, conf_path=None,
+                                   spawn_fn=spawn, probe_fn=probe,
+                                   ping_interval_s=0.1,
+                                   backoff_base_s=6.0,
+                                   backoff_cap_s=8.0)
+            reg = BreakerRegistry(threshold=3, cooldown_s=1.0,
+                                  enabled=True)
+            stream = os.path.join(
+                cdir, f"stream-{'on' if policy_on else 'off'}")
+            cmgr = DiffEpochManager(stream, poll_ms=25.0)
+            cdisp = _GatedDispatch(
+                EngineDispatcher(cconf, graph=cg, dc=cdc), alive)
+            fe = ServingFrontend(
+                cdc, cdisp,
+                sconf=ServeConfig(max_batch=32, max_wait_ms=2.0,
+                                  queue_depth=max(cn, 2048),
+                                  deadline_ms=2000.0).validate(),
+                hconf=HedgeConfig(enabled=False), traffic=cmgr,
+                registry=reg, breaker_key=lambda wid: wid)
+            daemon = None
+            if policy_on:
+                daemon = ControlDaemon(
+                    ControlConfig(enabled=True, interval_s=0.1,
+                                  cooldown_s=0.5, hold_ticks=1,
+                                  clean_probes=1, unhealthy_pings=2),
+                    supervisor=sup, registry=reg, frontend=fe,
+                    breaker_key=lambda wid: wid,
+                    replicate_fn=lambda shard: None,
+                    probe_fn=lambda wid: bool(alive.get(wid)))
+            sup.start(wait_ready_s=10)
+            fe.start()
+            if daemon is not None:
+                daemon.start()
+            kill_at = cn // 3
+            shift_at = (2 * cn) // 3
+            t_kill = None
+            try:
+                # warm: engines compiled, shapes resident
+                for f in [fe.submit(int(s), int(t))
+                          for s, t in cpool[:64]]:
+                    f.result(60)
+                submits, futs = [], []
+                for i, (s, t) in enumerate(cpool):
+                    if i == kill_at:
+                        # the incident: worker 1 dies mid-serve
+                        t_kill = time.monotonic()
+                        procs[1].dead = True
+                        alive[1] = False
+                    if i == shift_at:
+                        # the hotspot shift: a rush-hour segment lands
+                        # and the pump swaps the fused diff live
+                        cscen.replay([ctrace[0]], stream)
+                    submits.append(time.monotonic())
+                    futs.append(fe.submit(int(s), int(t)))
+                    time.sleep(0.003)
+                res = [f.result(60) for f in futs]
+                t_end = time.monotonic()
+            finally:
+                if daemon is not None:
+                    daemon.stop()
+                fe.stop()
+                sup.stop()
+                reg.shutdown()
+            ok = [(r, ts) for r, ts in zip(res, submits) if r.ok]
+            shed_rate = 1.0 - len(ok) / len(res)
+            lat = [(r.t_done - ts) * 1e3 for r, ts in ok]
+            p99 = float(np.percentile(lat, 99)) if lat else float("nan")
+            # recovery: first OK non-cached answer to a query SUBMITTED
+            # after the kill and routed to the killed worker's shard —
+            # in-flight stragglers and cache hits don't prove the
+            # worker came back
+            healed = [r.t_done for r, ts in ok
+                      if t_kill is not None and ts > t_kill
+                      and not r.cached
+                      and int(cdc.worker_of(np.asarray([r.t]))[0]) == 1]
+            # no healed sample within the burst → report the observed
+            # outage as a floor (the fleet never recovered on camera)
+            recover = (min(healed) - t_kill) if healed \
+                else (t_end - t_kill if t_kill else 0.0)
+            return shed_rate, recover, p99
+
+        shed_off, rec_off, p99_off = _control_run(policy_on=False)
+        log(f"  policy OFF: shed {shed_off:.1%}, recover "
+            f"{rec_off:.2f}s, p99 {p99_off:.1f} ms")
+        shed_on, rec_on, p99_on = _control_run(policy_on=True)
+        log(f"  policy ON:  shed {shed_on:.1%}, recover "
+            f"{rec_on:.2f}s, p99 {p99_on:.1f} ms")
+        control_stats = {
+            "control_shed_rate": round(shed_on, 4),
+            "control_recover_seconds": round(rec_on, 3),
+            "control_p99_ms": round(p99_on, 3),
+            "control_off_shed_rate": round(shed_off, 4),
+            "control_off_recover_seconds": round(rec_off, 3),
+            "control_off_p99_ms": round(p99_off, 3),
+        }
+        shutil.rmtree(cdir, ignore_errors=True)
+
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     detail = {
         "graph_nodes": g.n,
@@ -2789,6 +3006,7 @@ def main() -> None:
         **repl_stats,
         **reshard_stats,
         **traffic_stats,
+        **control_stats,
         "devices": len(devices),
         "platform": devices[0].platform,
     }
@@ -2848,6 +3066,8 @@ def main() -> None:
         "telemetry_head_ingest_per_sec",
         "traffic_live_swap_queries_per_sec", "traffic_swap_stall_p99_ms",
         "traffic_scoped_hit_rate",
+        "control_shed_rate", "control_off_shed_rate",
+        "control_recover_seconds", "control_off_recover_seconds",
         "devices", "platform",
     )
     headline = {k: detail[k] for k in headline_keys if k in detail}

@@ -281,7 +281,7 @@ def main(argv=None) -> int:
     frontend, registry, families = build_frontend(conf, args)
     frontend.start()
     obs_srv = None
-    head_pub = poller = slo_engine = recorder = None
+    head_pub = poller = slo_engine = recorder = daemon = None
     # graceful drain: SIGTERM (the orchestrator's stop signal) and
     # SIGINT both stop ingress — the event ends the socket/tail loops,
     # the exception unwinds a blocking stdin read — then the finally
@@ -339,17 +339,35 @@ def main(argv=None) -> int:
             head_pub = obs_telemetry.TelemetryPublisher(
                 source="head", sinks=[tele_ingest.ingest]).start()
         slo_engine = obs_slo.SLOEngine(store).start()
+        # closed-loop control (DOS_CONTROL=1): the policy daemon senses
+        # this head's SLO burn, queues, breakers and worker telemetry,
+        # and executes the brownout/quarantine/repair/warming ladder
+        # against the same in-process handles. Off by default: nothing
+        # is constructed and serving is byte-identical legacy.
+        from ..control import maybe_daemon
+        probe_fn = None
+        if registry is not None and registry.probe_fn is not None:
+            def probe_fn(wid):
+                st = registry.probe_fn(frontend._breaker_key(wid))
+                return st is not None and getattr(st, "ok", False)
+        daemon = maybe_daemon(
+            slo=slo_engine, frontend=frontend, registry=registry,
+            membership=frontend.membership, ingest=tele_ingest,
+            probe_fn=probe_fn)
+        status_providers = {
+            "serving": frontend.statusz,
+            "device_programs": obs_device.snapshot,
+            "telemetry": tele_ingest.statusz,
+            "slo": slo_engine.statusz,
+        }
+        if daemon is not None:
+            status_providers["control"] = daemon.statusz
         obs_srv = start_obs_server(
             args.obs_port,
             health_fn=lambda: {
                 "ok": frontend._started and not frontend._closed,
                 "role": "dos-serve", "backend": args.backend},
-            status_providers={
-                "serving": frontend.statusz,
-                "device_programs": obs_device.snapshot,
-                "telemetry": tele_ingest.statusz,
-                "slo": slo_engine.statusz,
-            },
+            status_providers=status_providers,
             slo_provider=slo_engine.payload)
         if args.ingress == "stdin":
             n = ingress.serve_stdin(frontend, families=families)
@@ -368,6 +386,8 @@ def main(argv=None) -> int:
         log.info("interrupted; draining")
     finally:
         stop_evt.set()
+        if daemon is not None:
+            daemon.stop()
         frontend.stop()
         if obs_srv is not None:
             obs_srv.close()

@@ -173,6 +173,9 @@ fleet"):
   ``reshard_migrations_total`` (windows begun),
   ``reshard_shards_moved_total`` (ownership transfers committed),
   ``reshard_aborted_total`` (windows closed without the bump),
+  ``reshard_leave_refused_total`` (leave plans refused because a shard
+  had no live replica-chain adopter — R=1 sole owner; refusing beats
+  stranding it mid-window),
   ``reshard_catchup_seconds`` (per-shard adopter verify+heal);
 * catch-up data plane — ``reshard_blocks_adopted_total`` (blocks
   digest-verified/healed by an adopting worker; the heal path itself
@@ -201,7 +204,10 @@ traffic"):
   hit rate the bench headlines);
 * query families — ``serve_matrix_requests_total`` (one-to-many ETA
   rows), ``serve_alt_requests_total`` (k-alternative routes),
-  ``serve_reverse_requests_total`` (reverse source-owner routing);
+  ``serve_reverse_requests_total`` (reverse source-owner routing),
+  ``serve_shed_family_total`` (typed family requests answered BUSY by
+  the control plane's brownout ladder — level >= 2 sheds mat/alt
+  while plain pair queries keep flowing);
 * version gate — ``server_stale_diff_total`` (batches a worker refused
   with the ``STALE_DIFF`` wire sentinel: fused at a NEWER diff epoch
   than the worker's segment stream shows even after a refresh — the
@@ -341,6 +347,27 @@ record`` / ``dos-obs replay``):
   ``recorder_segments_total`` (segment rotations),
   ``recorder_torn_lines_total`` (torn tail lines skipped at replay),
   ``recorder_ring_bytes`` (gauge: on-disk ring footprint).
+
+Closed-loop control (``control/`` — the policy daemon that turns the
+sensors above into automatic recovery actions, ``DOS_CONTROL``;
+README "Closed-loop control"):
+
+* loop — ``control_ticks_total`` (sense->decide->act passes),
+  ``control_decisions_total`` (decisions reached: executed, dry-run,
+  or budget-denied), ``control_actions_total`` (actions executed),
+  ``control_budget_denied_total`` (decisions past the global action
+  budget), ``control_errors_total`` (actuator executions that raised);
+* quarantine — ``control_quarantines_total`` (sick workers removed
+  from routing: breaker pin + respawn kick),
+  ``control_readmissions_total`` (re-admitted after N clean probes);
+* brownout — ``control_brownout_shifts_total`` (ladder level changes),
+  ``control_brownout_level`` (gauge: current level, 0 = full service);
+* repair / scale — ``control_repairs_total`` (plan_join / plan_leave /
+  hot-shard replication executed), ``control_scale_advised_total``
+  (scale-up advisories booked where the daemon owns no actuator:
+  no join host configured, or lane widening needing a worker restart);
+* warming — ``control_warms_total`` (next diff epoch pre-fused /
+  registered warmers run ahead of the pump cadence).
 """
 
 from . import device, fleet, metrics, quantiles, trace

@@ -302,6 +302,23 @@ def _summarize(status: dict) -> dict:
         lags = [v for v in lags if v is not None]
         if lags:
             out["tel lag"] = round(max(lags), 1)
+    # closed-loop control columns: policy state (brownout level, dry-run
+    # tag), last action, quarantined workers. Only a daemon-enabled
+    # endpoint ships the section; every other row shows "-" blanks —
+    # the same mixed-schema tolerance as the slo/telemetry columns
+    ctl = status.get("control")
+    if isinstance(ctl, dict) and ctl:
+        lvl = ctl.get("brownout_level")
+        if isinstance(lvl, (int, float)) and not isinstance(lvl, bool):
+            tag = "dry:" if ctl.get("dry_run") is True else ""
+            out["policy"] = f"{tag}L{int(lvl)}"
+        last = ctl.get("last_action")
+        if isinstance(last, str) and last:
+            out["last action"] = last.split(" ", 1)[0]
+        quarantined = ctl.get("quarantined")
+        if isinstance(quarantined, list) and quarantined:
+            out["quarantined"] = ",".join(
+                str(w) for w in quarantined)
     mig = serving.get("migration") or worker.get("migration")
     if isinstance(mig, dict):
         moves = mig.get("moves") if isinstance(mig.get("moves"), list) \
@@ -417,6 +434,18 @@ _KEY_DIRECTIONS = {
     "telemetry_head_ingest_per_sec": "higher",
     "telemetry_publish_p99_ms": "lower",
     "telemetry_publish_overhead_frac": "lower",
+    # the closed-loop control family (policy daemon, PR 17): both arms'
+    # time-to-recover and shed rate improve DOWN — shed_rate defeats
+    # the suffix heuristic (no _ms/_seconds), and the policy-off
+    # baselines gate too so a regression in the daemon-off recovery
+    # path (supervisor backoff, breaker heal) cannot hide behind the
+    # policy-on deltas
+    "control_recover_seconds": "lower",
+    "control_shed_rate": "lower",
+    "control_p99_ms": "lower",
+    "control_off_recover_seconds": "lower",
+    "control_off_shed_rate": "lower",
+    "control_off_p99_ms": "lower",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -454,6 +483,17 @@ _KEY_TOLERANCES = {
     "telemetry_publish_p99_ms": 0.5,
     "telemetry_publish_overhead_frac": 0.5,
     "telemetry_head_ingest_per_sec": 0.5,
+    # recovery timings are dominated by backoff/probe cadences racing
+    # host scheduling jitter; shed rates depend on exactly how many
+    # requests land inside the outage window — gate all four loosely
+    # (a real regression, e.g. re-admission stops happening, blows far
+    # past 2x)
+    "control_recover_seconds": 0.5,
+    "control_shed_rate": 0.5,
+    "control_off_recover_seconds": 0.5,
+    "control_off_shed_rate": 0.5,
+    "control_p99_ms": 0.5,
+    "control_off_p99_ms": 0.5,
 }
 
 

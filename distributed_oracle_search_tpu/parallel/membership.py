@@ -90,6 +90,10 @@ M_SHARDS_MOVED = obs_metrics.counter(
 M_ABORTED = obs_metrics.counter(
     "reshard_aborted_total",
     "migration windows explicitly aborted (owner table unchanged)")
+M_LEAVE_REFUSED = obs_metrics.counter(
+    "reshard_leave_refused_total",
+    "leave plans refused because a shard had no live replica-chain "
+    "adopter (R=1 sole owner) — refusing beats stranding it mid-window")
 H_CATCHUP = obs_metrics.histogram(
     "reshard_catchup_seconds",
     "per-shard adopter catch-up: digest-verify + heal/copy of one "
@@ -413,7 +417,7 @@ class MembershipController:
         return Migration(epoch=self.state.epoch + 1, kind="join",
                          worker=new_wid, moves=moves, host=host)
 
-    def plan_leave(self, wid: int) -> Migration:
+    def plan_leave(self, wid: int, live=None) -> Migration:
         """Transfer every shard ``wid`` owns before it drains:
         ownership goes to the next host in the shard's replica chain
         that is not the leaver (a worker already holding the rows — the
@@ -423,25 +427,60 @@ class MembershipController:
         entries are never pruned on leave (worker ids are positional),
         so a previously-departed worker still has a roster slot — and
         committing a shard onto a drained host would make it
-        permanently unroutable."""
+        permanently unroutable.
+
+        ``live`` (optional set of worker ids known to be serving)
+        restricts adopters: the control daemon removing a dead worker
+        must not move its shards onto another sick one. When filtering
+        leaves a shard with NO adopter at all (R=1 sole owner and no
+        live peer owns anything), the plan **refuses** — a per-shard
+        diagnostic plus ``reshard_leave_refused_total`` — instead of
+        opening a dual-read window that could never drain. ``live=None``
+        preserves the pre-control behavior bit-for-bit."""
         owners = self._owners()
         dc = self.dc_view()
         remaining = sorted(set(owners) - {int(wid)})
+        if live is not None:
+            live = {int(w) for w in live}
+            remaining = [w for w in remaining if w in live]
         if not remaining:
+            if live is not None:
+                M_LEAVE_REFUSED.inc()
             raise ValueError("cannot remove the last shard-owning "
                              "worker")
         moves: list[list[int]] = []
+        stranded: list[str] = []
         rr = 0
         for shard, owner in enumerate(owners):
             if owner != int(wid):
                 continue
-            target = next(
-                (h for h in dc.replica_workers(shard)
-                 if h != int(wid)), None)
+            chain = [h for h in dc.replica_workers(shard)
+                     if h != int(wid)]
+            if live is not None:
+                # the leaver is presumed dead: the adopter must ALREADY
+                # hold the rows (be a live replica-chain host) because
+                # catch-up cannot copy from a corpse. Round-robin onto
+                # a non-replica is only safe on the legacy live=None
+                # path, where the leaver itself serves the catch-up.
+                alive_chain = [h for h in chain if h in live]
+                if not alive_chain:
+                    stranded.append(
+                        f"shard {shard}: replica chain {chain or '[]'} "
+                        f"has no live host (sole owner at R="
+                        f"{int(dc.replication)})")
+                    continue
+                moves.append([shard, owner, int(alive_chain[0])])
+                continue
+            target = next(iter(chain), None)
             if target is None:
                 target = remaining[rr % len(remaining)]
                 rr += 1
             moves.append([shard, owner, int(target)])
+        if stranded:
+            M_LEAVE_REFUSED.inc()
+            raise ValueError(
+                f"refusing leave of worker {int(wid)}: "
+                + "; ".join(stranded))
         return Migration(epoch=self.state.epoch + 1, kind="leave",
                          worker=int(wid), moves=moves)
 
@@ -599,12 +638,14 @@ class MembershipController:
         self.catch_up(mig)
         return self.commit(mig)
 
-    def leave(self, wid: int) -> MembershipState:
+    def leave(self, wid: int, live=None) -> MembershipState:
         """Plan + begin + catch up + commit one worker leave. The
         caller drains the worker AFTER the commit (its shards have new
         owners by then; in-flight batches it already read are answered
-        before the stop token wins — drain-free by construction)."""
-        mig = self.begin(self.plan_leave(wid))
+        before the stop token wins — drain-free by construction).
+        ``live`` restricts adopters to known-serving workers (see
+        :meth:`plan_leave`)."""
+        mig = self.begin(self.plan_leave(wid, live=live))
         self.catch_up(mig)
         return self.commit(mig)
 

@@ -136,6 +136,11 @@ class ServingFrontend:
         #: quantiles drive the duplicate-request delay, a rate budget
         #: bounds the duplicates
         self.hedge = HedgeTracker(hconf or HedgeConfig.from_env())
+        #: typed query families currently shed by the control plane's
+        #: brownout ladder (empty = everything admitted). Read by
+        #: ``traffic.families.QueryFamilies`` before submit; plain s-t
+        #: queries are never in this set.
+        self.shed_families: frozenset = frozenset()
         self._queues: dict[int, ShardQueue] = {}
         self._batchers: dict[int, MicroBatcher] = {}
         for wid in range(dc.maxworker):
@@ -276,6 +281,24 @@ class ServingFrontend:
             timeout = self.sconf.deadline_s + 30.0
         return self.submit(s, t).result(timeout)
 
+    # --------------------------------------------------- brownout hooks
+    # Mutators for the control plane's brownout ladder. Both configs
+    # are frozen dataclasses, so each step swaps in a fresh immutable
+    # snapshot (``dataclasses.replace``) rather than mutating shared
+    # state under readers — a dispatch thread mid-request sees either
+    # the old config or the new one, never a torn mix.
+    def set_hedge_budget(self, budget: float) -> None:
+        self.hedge.config = dataclasses.replace(
+            self.hedge.config, budget=float(budget))
+
+    def set_deadline_ms(self, ms: float) -> None:
+        """Applies to requests admitted from now on; in-flight requests
+        keep the absolute deadline stamped at submit."""
+        self.sconf = dataclasses.replace(self.sconf, deadline_ms=float(ms))
+
+    def set_family_shed(self, kinds) -> None:
+        self.shed_families = frozenset(kinds)
+
     # ------------------------------------------------------------ statusz
     def statusz(self) -> dict:
         """Live serving state for the ``/statusz`` endpoint
@@ -316,6 +339,10 @@ class ServingFrontend:
                 "max_bytes": self.cache.max_bytes,
             },
         }
+        if self.shed_families:
+            # only under an active brownout — the legacy statusz body
+            # stays byte-identical when the control plane is off
+            out["shed_families"] = sorted(self.shed_families)
         # worker mesh shape (DOS_MESH_DEVICES resolution) — reported
         # best-effort: a head whose backend cannot resolve devices
         # (host-wire frontend with no local accelerator runtime) shows

@@ -41,7 +41,7 @@ import time
 from ..data.formats import read_diff
 from ..obs import metrics as obs_metrics
 from ..utils.log import get_logger
-from ..serving.request import OK
+from ..serving.request import BUSY, Future, OK, ServeResult
 
 log = get_logger(__name__)
 
@@ -54,6 +54,9 @@ M_ALT = obs_metrics.counter(
 M_REV = obs_metrics.counter(
     "serve_reverse_requests_total",
     "reverse source-owner routing requests (rev family)")
+M_FAMILY_SHED = obs_metrics.counter(
+    "serve_shed_family_total",
+    "typed family requests shed by the control plane's brownout ladder")
 
 
 def parse_family_line(line: str):
@@ -293,6 +296,16 @@ class QueryFamilies:
     # ------------------------------------------------------------ ingress
     def submit_line(self, kind: str, args):
         """Dispatch one parsed family line (``serving.ingress``)."""
+        shed = getattr(self.frontend, "shed_families", None)
+        if shed and kind in shed:
+            # brownout ladder level >= 2: expensive fan-out families
+            # answer BUSY immediately (in-order, like any shed) while
+            # plain pair queries keep flowing
+            M_FAMILY_SHED.inc()
+            s = int(args[0]) if args else -1
+            t = int(args[1]) if kind != "mat" and len(args) > 1 else -1
+            return Future.completed(ServeResult(
+                BUSY, s, t, detail="brownout-shed"))
         if kind == "mat":
             return self.matrix(args[0], args[1])
         if kind == "alt":

@@ -71,6 +71,12 @@ class CircuitBreaker:
         self.consecutive_failures = 0
         self.opened_at = 0.0
         self._trial_in_flight = False
+        #: control-plane quarantine pin: while True the breaker is held
+        #: OPEN and nothing in the normal state machine — cooldown
+        #: lapse, probe half-open, a lucky good record() — can heal it.
+        #: Only :meth:`release` (the daemon's re-admission decision,
+        #: after N clean probes) clears it.
+        self.pinned = False
         self._lock = OrderedLock("resilience.CircuitBreaker")
 
     def allow(self) -> bool:
@@ -81,6 +87,9 @@ class CircuitBreaker:
         ev = None
         try:
             with self._lock:
+                if self.pinned:
+                    M_REJECTED.inc()
+                    return False
                 if self.state == CLOSED:
                     return True
                 if self.state == OPEN:
@@ -108,6 +117,10 @@ class CircuitBreaker:
             with self._lock:
                 trial = self._trial_in_flight
                 self._trial_in_flight = False
+                if self.pinned:
+                    # outcomes recorded while quarantined must not heal
+                    # (or further trip) the pinned state machine
+                    return
                 if ok:
                     self.consecutive_failures = 0
                     if self.state != CLOSED:
@@ -151,6 +164,8 @@ class CircuitBreaker:
         pick admission/hedge targets without disturbing the breaker's
         state machine."""
         with self._lock:
+            if self.pinned:
+                return False
             if self.state == OPEN:
                 return self.clock() - self.opened_at >= self.cooldown_s
             return True
@@ -158,7 +173,7 @@ class CircuitBreaker:
     def half_open(self, why: str = "probe") -> None:
         fired = False
         with self._lock:
-            if self.state == OPEN:
+            if self.state == OPEN and not self.pinned:
                 self._to_half_open_locked(why)
                 fired = True
         if fired:    # outside the breaker lock, like every transition
@@ -170,6 +185,48 @@ class CircuitBreaker:
         self.state = HALF_OPEN
         self._trial_in_flight = False
         M_PROBE_HALF_OPEN.inc()
+
+    # ------------------------------------------------ control-plane pin
+    def force_open(self, why: str = "quarantine") -> None:
+        """Pin the breaker OPEN (sick-worker quarantine). Idempotent."""
+        ev = None
+        with self._lock:
+            if self.pinned:
+                return
+            self.pinned = True
+            if self.state == CLOSED:
+                G_OPEN.add(1)
+            if self.state != OPEN:
+                self.state = OPEN
+                self.opened_at = self.clock()
+                self._trial_in_flight = False
+                M_OPENED.inc()
+                ev = ("breaker_open", f"pinned: {why}")
+        if ev is not None:
+            obs_recorder.emit(ev[0], key=str(self.key), why=ev[1])
+        log.warning("circuit for %s pinned OPEN (%s)", self.key, why)
+
+    def release(self, close: bool = True,
+                why: str = "quarantine cleared") -> None:
+        """Unpin. ``close=True`` (the daemon's post-probation
+        re-admission) CLOSEs outright; ``close=False`` hands the worker
+        back to the normal OPEN machinery (cooldown/probe trial)."""
+        ev = None
+        with self._lock:
+            if not self.pinned:
+                return
+            self.pinned = False
+            if close and self.state != CLOSED:
+                self.state = CLOSED
+                self.consecutive_failures = 0
+                self._trial_in_flight = False
+                M_CLOSED.inc()
+                G_OPEN.add(-1)
+                ev = ("breaker_close", why)
+        if ev is not None:
+            obs_recorder.emit(ev[0], key=str(self.key), why=ev[1])
+        log.info("circuit for %s unpinned (%s, close=%s)", self.key,
+                 why, close)
 
 
 class BreakerRegistry:
@@ -231,6 +288,23 @@ class BreakerRegistry:
         if br.state == OPEN and was_open != OPEN:
             self._start_probe(br)
 
+    def force_open(self, key, why: str = "quarantine") -> bool:
+        """Control-plane quarantine: pin ``key``'s breaker OPEN. Returns
+        False (no-op) when breakers are disabled."""
+        if not self.enabled:
+            return False
+        self.get(key).force_open(why)
+        return True
+
+    def release(self, key, close: bool = True,
+                why: str = "quarantine cleared") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            br = self._breakers.get(key)
+        if br is not None:
+            br.release(close=close, why=why)
+
     # ------------------------------------------------------ probe loops
     def _start_probe(self, br: CircuitBreaker) -> None:
         if self.probe_fn is None or self._stop.is_set():
@@ -268,6 +342,7 @@ class BreakerRegistry:
         """State of every breaker (for ``degraded.json`` and logs)."""
         with self._lock:
             return {repr(k): {"state": b.state,
+                              "pinned": b.pinned,
                               "consecutive_failures":
                                   b.consecutive_failures}
                     for k, b in self._breakers.items()}

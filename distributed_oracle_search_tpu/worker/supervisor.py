@@ -385,6 +385,26 @@ class WorkerSupervisor:
                 "ping_interval_s": self.ping_interval_s,
                 "workers": workers}
 
+    def kick(self, wid: int) -> bool:
+        """Control-plane respawn accelerator: clear ``wid``'s backoff
+        schedule so the monitor's next tick respawns a dead worker
+        immediately instead of waiting out the exponential backoff.
+        The control daemon calls this when it has *decided* the worker
+        is sick — evidence the backoff's "maybe it is flapping" caution
+        no longer applies to. Returns True when an immediate respawn
+        was scheduled (the worker is currently dead)."""
+        with self._lock:
+            w = self.workers.get(wid)
+        if w is None:
+            return False
+        w.backoff_k = 0
+        dead = w.proc is None or w.proc.poll() is not None
+        if dead:
+            # overwrite any already-scheduled backoff wait; 0.0 is the
+            # "death not yet observed" sentinel so schedule explicitly
+            w.next_spawn_at = time.monotonic()
+        return dead
+
     # --------------------------------------------------------- monitor
     def _backoff_s(self, w: SupervisedWorker) -> float:
         return min(self.backoff_cap_s,
@@ -493,17 +513,27 @@ def supervise_forever(conf: ClusterConfig, conf_path: str,
 
     from ..obs import telemetry as obs_telemetry
 
+    from ..control import maybe_daemon
+
     sup = WorkerSupervisor(conf, conf_path, alg=alg, logdir=logdir,
                            traffic_dir=traffic_dir)
     obs_srv = None
     publisher = None
+    daemon = None
     try:
         sup.start()
+        # closed-loop control (DOS_CONTROL=1): supervise-side the
+        # daemon senses the supervisor only — it accelerates respawns
+        # of workers it has decided are sick and journals the incident
+        daemon = maybe_daemon(supervisor=sup)
+        providers = {"supervisor": sup.statusz}
+        if daemon is not None:
+            providers["control"] = daemon.statusz
         # inside the try: a bind failure (port taken) must tear the
         # just-spawned workers down, not orphan them unsupervised
         obs_srv = start_obs_server(
             obs_port, health_fn=sup.health,
-            status_providers={"supervisor": sup.statusz})
+            status_providers=providers)
         # fleet telemetry: the supervisor's own counters (respawns,
         # ping failures) ride the sidecar lane beside the workers' —
         # its file lands in the FIFO directory the head already polls
@@ -523,6 +553,8 @@ def supervise_forever(conf: ClusterConfig, conf_path: str,
     except KeyboardInterrupt:
         log.info("supervisor: interrupted; stopping workers")
     finally:
+        if daemon is not None:
+            daemon.stop()
         if publisher is not None:
             publisher.stop()
         if obs_srv is not None:

@@ -291,3 +291,106 @@ def test_chaos_kill_worker_mid_round_supervised(chaos_cluster, tmp_path,
     finally:
         sup.stop()
     assert all(w.proc.poll() is not None for w in sup.workers.values())
+
+
+@pytest.mark.slow
+@pytest.mark.control
+def test_chaos_control_daemon_self_heals_killed_worker(
+        chaos_cluster, tmp_path, monkeypatch):
+    """The closed-loop drill: with the policy daemon attached, a worker
+    killed mid-campaign is quarantined, kick-respawned past the
+    supervisor's backoff, probed clean and re-admitted with ZERO
+    operator action; the campaign completes CLEAN (the retry meets the
+    replacement) with answers bit-identical to the fault-free run, and
+    the flight recorder shows the causal detect -> quarantine ->
+    recover timeline."""
+    import csv
+
+    from distributed_oracle_search_tpu.control import daemon as dmod
+    from distributed_oracle_search_tpu.control import maybe_daemon
+    from distributed_oracle_search_tpu.obs import recorder as obs_rec
+
+    def _answers(outdir):
+        with open(os.path.join(outdir, "parts.csv")) as fh:
+            rows = list(csv.reader(fh))
+        keep = [rows[0].index(k) for k in
+                ("expe", "n_expanded", "n_touched", "plen", "finished",
+                 "size")]
+        return [[r[i] for i in keep] for r in rows[1:]]
+
+    faults.reset()
+    state = str(tmp_path / "faults-state.json")
+    # the kill is armed from the START (supervised workers inherit env
+    # at spawn) but ``after=2`` skips worker 1's two fault-free batches
+    # of the reference run — the cross-process state file keeps the
+    # skip-count true across both campaigns and the respawn
+    monkeypatch.setenv("DOS_FAULTS",
+                       "kill-mid-batch;wid=1;times=1;after=2")
+    monkeypatch.setenv("DOS_FAULTS_STATE", state)
+    monkeypatch.setenv("DOS_SEND_TIMEOUT_S", "120")
+    monkeypatch.setenv("DOS_RETRY_MAX", "1")
+    monkeypatch.setenv("DOS_RETRY_BASE_S", "0.2")
+    monkeypatch.setenv("DOS_RETRY_JITTER", "0")
+    conf, conf_path = _conf(chaos_cluster, "conf-control.json",
+                            diffs=["-", "-"])
+    fifo_dir = str(tmp_path / "fifos")
+    os.makedirs(fifo_dir)
+    monkeypatch.setattr(
+        pq, "command_fifo_path",
+        lambda wid: os.path.join(fifo_dir, f"worker{wid}.fifo"))
+    sup = WorkerSupervisor(conf, conf_path, fifo_dir=fifo_dir,
+                           logdir=str(tmp_path / "logs"),
+                           ping_interval_s=0.5, backoff_base_s=5.0,
+                           backoff_cap_s=20.0, probe_timeout_s=5.0)
+    rec = obs_rec.FlightRecorder(str(tmp_path / "tape"), flush_every=1)
+    out0 = str(tmp_path / "artifacts-ref")
+    out1 = str(tmp_path / "artifacts-healed")
+    monkeypatch.setenv("DOS_CONTROL", "1")
+    monkeypatch.setenv("DOS_CONTROL_INTERVAL_S", "0.25")
+    monkeypatch.setenv("DOS_CONTROL_CLEAN_PROBES", "2")
+    actions0 = dmod.M_ACTIONS.value
+    quar0 = dmod.M_QUARANTINES.value
+    readmit0 = dmod.M_READMISSIONS.value
+    sup.start(wait_ready_s=300)
+    daemon = None
+    try:
+        # fault-free reference run (fault budget skips its batches)
+        rc = pq.main(["-c", conf_path, "--backend", "host",
+                      "-o", out0])
+        assert rc == pq.EXIT_CLEAN
+        assert sup.workers[1].respawns == 0
+        # arm the tape + the daemon, then the incident run
+        obs_rec.set_recorder(rec)
+        daemon = maybe_daemon(supervisor=sup)
+        assert daemon is not None
+        rc = pq.main(["-c", conf_path, "--backend", "host",
+                      "-o", out1])
+        assert rc == pq.EXIT_CLEAN               # retry met replacement
+        assert not os.path.exists(os.path.join(out1, "degraded.json"))
+        assert sup.workers[1].respawns == 1
+        assert _answers(out0) == _answers(out1)  # bit-identical
+        # the daemon acted (quarantine at least; kick rode along)
+        assert dmod.M_ACTIONS.value > actions0
+        assert dmod.M_QUARANTINES.value >= quar0 + 1
+        # probation completes: the healed worker is re-admitted
+        deadline = time.monotonic() + 60
+        while (daemon.quarantine.quarantined()
+               and time.monotonic() < deadline):
+            time.sleep(0.25)
+        assert daemon.quarantine.quarantined() == []
+        assert dmod.M_READMISSIONS.value >= readmit0 + 1
+    finally:
+        if daemon is not None:
+            daemon.stop()
+        obs_rec.set_recorder(None)
+        sup.stop()
+        faults.reset()
+    rec.close()
+    records = obs_rec.replay(str(tmp_path / "tape"))
+    kinds = [r["kind"] for r in records if r.get("rec") == "event"]
+    assert "control_quarantine" in kinds
+    assert "control_readmit" in kinds
+    assert (kinds.index("control_quarantine")
+            < kinds.index("control_readmit"))
+    text = obs_rec.render_timeline(records)
+    assert "control_quarantine" in text and "control_readmit" in text

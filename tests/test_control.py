@@ -1,0 +1,851 @@
+"""Closed-loop control plane: policy daemon, brownout ladder,
+quarantine, elastic repair.
+
+Policy arms are unit-tested against stubs (decisions stay deterministic
+without a fleet); the actuation seams (breaker pin, supervisor kick,
+plan_leave live-set, frontend brownout knobs, family shed) run against
+the real subsystems; the non-slow core drill closes the loop end to end
+over a real :class:`WorkerSupervisor` with dummy subprocess workers.
+The full drill — supervised worker subprocesses killed mid-campaign,
+healed with zero operator action — is the slow daemon variant in
+test_chaos.py."""
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_oracle_search_tpu.control import (
+    ControlConfig, ControlDaemon, maybe_daemon,
+)
+from distributed_oracle_search_tpu.control import daemon as daemon_mod
+from distributed_oracle_search_tpu.control.actuators import Actuators
+from distributed_oracle_search_tpu.control.policy import (
+    BROWNOUT_SHED_FAMILIES, ActionBudget, BrownoutLadder,
+    HysteresisRule, QuarantineManager, RepairScaler,
+)
+from distributed_oracle_search_tpu.control.signals import ControlSignals
+from distributed_oracle_search_tpu.obs import fleet as obs_fleet
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.obs import recorder as obs_recorder
+from distributed_oracle_search_tpu.parallel import membership as fleet
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.transport.resilience import (
+    OPEN, BreakerRegistry, CircuitBreaker,
+)
+from distributed_oracle_search_tpu.transport.wire import HealthStatus
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker import supervisor as sup_mod
+from distributed_oracle_search_tpu.worker.supervisor import (
+    WorkerSupervisor,
+)
+
+pytestmark = pytest.mark.control
+
+
+def _counter(name):
+    return obs_metrics.REGISTRY.snapshot()["counters"].get(name, 0)
+
+
+def _sig(now=0.0, **kw):
+    return ControlSignals(now=now, **kw)
+
+
+def _cfg(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("hold_ticks", 1)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("clean_probes", 1)
+    return ControlConfig(**kw)
+
+
+def _drain_control_events():
+    return [e for e in obs_recorder.drain_pending()
+            if e["kind"].startswith("control_")]
+
+
+# ------------------------------------------------------- policy units
+
+def test_hysteresis_rule_trips_clears_and_never_flaps():
+    r = HysteresisRule("x", trip=10.0, clear_frac=0.5, hold_ticks=2,
+                       cooldown_s=5.0)
+    # one over-threshold tick is not enough (hold_ticks=2)
+    assert r.observe(12.0, now=0.0) is None
+    assert r.observe(12.0, now=1.0) == "trip"
+    assert r.tripped
+    # still over: no re-fire
+    assert r.observe(15.0, now=2.0) is None
+    # between clear (5.0) and trip: holds tripped
+    assert r.observe(7.0, now=3.0) is None
+    # clearing needs hold_ticks consecutive under-clear observations
+    assert r.observe(4.0, now=4.0) is None
+    assert r.observe(4.0, now=5.0) == "clear"
+    assert not r.tripped
+    # cooldown gates the next trip even with sustained overload
+    assert r.observe(12.0, now=5.5) is None
+    assert r.observe(12.0, now=5.6) is None     # hold met, cooldown not
+    assert r.observe(12.0, now=11.0) == "trip"  # 5s after last fire
+
+
+def test_hysteresis_rule_oscillating_signal_bounded_actions():
+    """A signal oscillating across the trip threshold every tick
+    produces ZERO trips: the hold counter resets on every dip."""
+    r = HysteresisRule("x", trip=10.0, hold_ticks=2, cooldown_s=0.0)
+    edges = [r.observe(v, now=i)
+             for i, v in enumerate([12, 4, 13, 3, 14, 2, 15, 1] * 4)]
+    assert edges.count("trip") == 0
+    # and None (sensor absent) holds state rather than clearing
+    r2 = HysteresisRule("y", trip=10.0, hold_ticks=1)
+    assert r2.observe(12.0, now=0.0) == "trip"
+    assert r2.observe(None, now=1.0) is None
+    assert r2.tripped
+
+
+def test_action_budget_sliding_window():
+    b = ActionBudget(2, window_s=10.0)
+    assert b.allow(0.0)
+    b.book(0.0)
+    b.book(1.0)
+    assert not b.allow(2.0)                    # exhausted
+    assert b.allow(10.5)                       # first booking aged out
+    assert b.statusz(10.5) == {"budget": 2, "window_s": 10.0, "used": 1}
+
+
+def test_brownout_ladder_escalates_and_clears_to_zero():
+    lad = BrownoutLadder(burn_trip=10.0, clear_frac=0.5, hold_ticks=1,
+                         cooldown_s=0.0)
+    # sustained over-threshold burn walks the whole ladder...
+    assert lad.decide(20.0, now=0.0) == 1
+    lad.level = 1
+    assert lad.decide(20.0, now=1.0) == 2
+    lad.level = 2
+    assert lad.decide(20.0, now=2.0) == 3
+    lad.level = 3
+    assert lad.decide(20.0, now=3.0) is None   # already at max
+    # ...but between trip and clear thresholds it holds, not escalates
+    assert lad.decide(7.0, now=4.0) is None
+    # clear steps ALL the way down, not one rung at a time
+    assert lad.decide(3.0, now=5.0) == 0
+    lad.level = 0
+    assert lad.decide(3.0, now=6.0) is None
+
+
+def test_brownout_ladder_cooldown_spaces_escalation_steps():
+    lad = BrownoutLadder(burn_trip=10.0, clear_frac=0.5, hold_ticks=1,
+                         cooldown_s=5.0)
+    assert lad.decide(20.0, now=0.0) == 1
+    lad.level = 1
+    assert lad.decide(20.0, now=1.0) is None   # inside cooldown
+    assert lad.decide(20.0, now=4.9) is None
+    assert lad.decide(20.0, now=5.0) == 2      # cooldown elapsed
+    lad.level = 2
+    assert lad.decide(None, now=11.0) is None  # missing data holds
+
+
+def test_quarantine_manager_state_machine():
+    qm = QuarantineManager(unhealthy_pings=2, clean_probes=2,
+                           dead_after_s=100.0, telemetry_lag_s=30.0,
+                           readmit_grace_s=5.0)
+    sick = _sig(worker_running={0: True, 1: True},
+                ping_failures={0: 0, 1: 3})
+    assert qm.decide(sick, now=0.0) == [
+        ("quarantine", 1, "3 consecutive ping failures")]
+    assert qm.quarantined() == [1]
+    assert qm.decide(sick, now=1.0) == []        # already quarantined
+    # probation: clean probes must be consecutive
+    assert not qm.probe_result(1, True)
+    assert not qm.probe_result(1, False)         # resets the streak
+    assert not qm.probe_result(1, True)
+    assert qm.probe_result(1, True)
+    qm.readmitted(1, now=2.0)
+    assert qm.quarantined() == []
+    # grace window: the stale ping-failure echo must not re-quarantine
+    assert qm.decide(sick, now=3.0) == []
+    assert qm.decide(sick, now=8.0) == [
+        ("quarantine", 1, "3 consecutive ping failures")]
+
+
+def test_quarantine_manager_dead_worker_escalates_to_leave():
+    qm = QuarantineManager(unhealthy_pings=2, clean_probes=1,
+                           dead_after_s=10.0, telemetry_lag_s=30.0)
+    dead = _sig(worker_running={0: False})
+    assert qm.decide(dead, now=0.0) == [("quarantine", 0,
+                                         "process dead")]
+    assert qm.decide(dead, now=5.0) == []
+    out = qm.decide(dead, now=10.0)
+    assert out == [("leave", 0, "unhealthy 10s")]
+    assert qm.quarantined() == []                # left, not quarantined
+
+
+def test_quarantine_manager_telemetry_lag_is_a_failure_signal():
+    qm = QuarantineManager(unhealthy_pings=5, clean_probes=1,
+                           dead_after_s=100.0, telemetry_lag_s=30.0)
+    lagging = _sig(worker_running={2: True}, telemetry_lag_s={2: 45.0})
+    assert qm.decide(lagging, now=0.0) == [
+        ("quarantine", 2, "telemetry silent 45s")]
+
+
+def test_repair_scaler_starvation_and_hot_shard():
+    rs = RepairScaler(starve_frac=0.9, hot_frac=0.6, clear_frac=0.5,
+                      hold_ticks=1, cooldown_s=0.0, join_host="")
+    # an absent frontend (no queue_depths) holds state — never trips
+    assert rs.decide(_sig(queue_frac=0.99), now=0.0) == []
+    starved = _sig(queue_frac=0.95, queue_depths={0: 95, 1: 90})
+    assert rs.decide(starved, now=1.0) == [("scale_advise",)]
+    rs2 = RepairScaler(starve_frac=0.9, hot_frac=0.6, clear_frac=0.5,
+                       hold_ticks=1, cooldown_s=0.0, join_host="h9")
+    assert rs2.decide(starved, now=0.0) == [("join", "h9")]
+    # hot shard: one shard holds > hot_frac of queued work
+    hot = _sig(queue_depths={0: 9, 1: 1}, hot_shard=0, hot_frac=0.9)
+    assert ("replicate", 0) in rs2.decide(hot, now=1.0)
+    # a drained fleet (shards present, empty) observes 0.0 and clears
+    rs3 = RepairScaler(starve_frac=0.9, hot_frac=0.6, clear_frac=0.5,
+                       hold_ticks=1, cooldown_s=0.0)
+    assert rs3.decide(starved, now=0.0) == [("scale_advise",)]
+    assert rs3._starve.tripped
+    rs3.decide(_sig(queue_frac=0.0, queue_depths={0: 0, 1: 0}),
+               now=1.0)
+    assert not rs3._starve.tripped
+
+
+# ---------------------------------------------------------- config
+
+def test_control_config_env_and_validation(monkeypatch):
+    monkeypatch.setenv("DOS_CONTROL", "1")
+    monkeypatch.setenv("DOS_CONTROL_INTERVAL_S", "0.5")
+    monkeypatch.setenv("DOS_CONTROL_DRY_RUN", "1")
+    monkeypatch.setenv("DOS_CONTROL_BUDGET", "3")
+    monkeypatch.setenv("DOS_CONTROL_JOIN_HOST", "spare-host")
+    cfg = ControlConfig.from_env()
+    assert cfg.enabled and cfg.dry_run
+    assert cfg.interval_s == 0.5 and cfg.budget == 3
+    assert cfg.join_host == "spare-host"
+    # impossible combinations disable the daemon instead of crashing
+    # the CLI that embeds it
+    monkeypatch.setenv("DOS_CONTROL_BUDGET", "0")
+    assert not ControlConfig.from_env().enabled
+    with pytest.raises(ValueError, match="budget"):
+        ControlConfig(budget=0).validate()
+
+
+def test_maybe_daemon_off_by_default(monkeypatch):
+    monkeypatch.delenv("DOS_CONTROL", raising=False)
+    assert maybe_daemon() is None
+    monkeypatch.setenv("DOS_CONTROL", "0")
+    assert maybe_daemon() is None
+
+
+# ----------------------------------------------------- decision seam
+
+class _SpyRegistry:
+    """Breaker-registry stand-in recording pin/release calls."""
+
+    def __init__(self):
+        self.forced, self.released = [], []
+
+    def force_open(self, key, why="quarantine"):
+        self.forced.append((key, why))
+        return True
+
+    def release(self, key, close=True, why=""):
+        self.released.append((key, close))
+
+    def get(self, key):
+        return None
+
+
+class _SpySupervisor:
+    def __init__(self, workers):
+        self._workers = workers
+        self.kicked = []
+
+    def statusz(self):
+        return {"workers": {str(w): dict(st)
+                            for w, st in self._workers.items()}}
+
+    def kick(self, wid):
+        self.kicked.append(wid)
+        return True
+
+
+def _mk_daemon(**kw):
+    kw.setdefault("config", _cfg())
+    cfg = kw.pop("config")
+    return ControlDaemon(cfg, **kw)
+
+
+def test_dry_run_books_every_decision_and_executes_nothing():
+    reg = _SpyRegistry()
+    sup = _SpySupervisor({0: {"running": False, "ping_failures": 0}})
+    d = _mk_daemon(config=_cfg(dry_run=True), supervisor=sup,
+                   registry=reg, breaker_key=lambda w: w,
+                   clock=lambda: 100.0)
+    obs_recorder.drain_pending()
+    decisions0 = daemon_mod.M_DECISIONS.value
+    actions0 = daemon_mod.M_ACTIONS.value
+    d.tick()
+    # the decision is booked: counter + recorder event, state advanced
+    assert daemon_mod.M_DECISIONS.value > decisions0
+    evs = _drain_control_events()
+    assert any(e["kind"] == "control_quarantine"
+               and e["mode"] == "dry-run"
+               and e["executed"] is False for e in evs)
+    assert d.quarantine.quarantined() == [0]
+    assert "quarantine(dry-run)" in d.last_action
+    # ...but NOTHING was executed
+    assert daemon_mod.M_ACTIONS.value == actions0
+    assert reg.forced == [] and reg.released == []
+    assert sup.kicked == []
+
+
+def test_quarantine_executes_pin_and_kick_then_readmits():
+    reg = _SpyRegistry()
+    sup = _SpySupervisor({0: {"running": True, "ping_failures": 0},
+                          1: {"running": False, "ping_failures": 0}})
+    probe_ok = {"ok": False}
+    d = _mk_daemon(config=_cfg(clean_probes=2), supervisor=sup,
+                   registry=reg, breaker_key=lambda w: ("h", w),
+                   probe_fn=lambda w: probe_ok["ok"],
+                   clock=lambda: 100.0)
+    q0 = daemon_mod.M_QUARANTINES.value
+    r0 = daemon_mod.M_READMISSIONS.value
+    d.tick()
+    assert reg.forced == [(("h", 1), "process dead")]
+    assert sup.kicked == [1]
+    assert daemon_mod.M_QUARANTINES.value == q0 + 1
+    assert d.statusz()["quarantined"] == [1]
+    # probation: failing probes keep it quarantined
+    d.tick()
+    assert d.quarantine.quarantined() == [1]
+    # two consecutive clean probes earn re-admission (breaker CLOSEs)
+    probe_ok["ok"] = True
+    sup._workers[1] = {"running": True, "ping_failures": 0}
+    d.tick()
+    assert d.quarantine.quarantined() == [1]    # 1 of 2 clean
+    d.tick()
+    assert d.quarantine.quarantined() == []
+    assert reg.released == [(("h", 1), True)]
+    assert daemon_mod.M_READMISSIONS.value == r0 + 1
+
+
+def test_budget_denied_books_the_decision_without_acting():
+    reg = _SpyRegistry()
+    sup = _SpySupervisor({0: {"running": False, "ping_failures": 0},
+                          1: {"running": False, "ping_failures": 0}})
+    d = _mk_daemon(config=_cfg(budget=1), supervisor=sup,
+                   registry=reg, breaker_key=lambda w: w,
+                   probe_fn=lambda w: False, clock=lambda: 100.0)
+    denied0 = daemon_mod.M_BUDGET_DENIED.value
+    obs_recorder.drain_pending()
+    d.tick()
+    # two sick workers, budget for one: the second books budget-denied
+    assert len(reg.forced) == 1
+    assert daemon_mod.M_BUDGET_DENIED.value == denied0 + 1
+    modes = [e["mode"] for e in _drain_control_events()
+             if e["kind"] == "control_quarantine"]
+    assert sorted(modes) == ["budget-denied", "executed"]
+
+
+def test_actuator_error_is_counted_and_loop_survives():
+    # a daemon with NO actuators: the quarantine decision books an
+    # error (wiring bug made visible) and the tick completes
+    sup = _SpySupervisor({0: {"running": False, "ping_failures": 0}})
+
+    class _NoActSup(_SpySupervisor):
+        def kick(self, wid):
+            raise RuntimeError("kick transport down")
+
+    sup = _NoActSup({0: {"running": False, "ping_failures": 0}})
+    d = _mk_daemon(supervisor=sup, clock=lambda: 100.0)
+    e0 = daemon_mod.M_ERRORS.value
+    d.tick()
+    assert daemon_mod.M_ERRORS.value == e0 + 1
+    assert "quarantine(error)" in d.last_action
+
+
+def test_warm_bypasses_action_budget():
+    warmed = []
+    d = _mk_daemon(config=_cfg(budget=1), warm_fns=[lambda:
+                                                    warmed.append(1)],
+                   clock=lambda: 100.0)
+    d.budget.book(100.0)                 # budget already exhausted
+    w0 = daemon_mod.M_WARMS.value
+    d.tick()
+    assert warmed == [1]                 # warm still ran
+    assert daemon_mod.M_WARMS.value == w0 + 1
+    # cooldown spaces warms (cooldown_s=0 here: every tick re-warms)
+    d.tick()
+    assert warmed == [1, 1]
+
+
+def test_repair_decisions_route_to_actuators():
+    calls = {"join": [], "repl": []}
+
+    class _MC:
+        def join(self, host):
+            calls["join"].append(host)
+
+        def leave(self, wid, live=None):
+            pass
+
+    class _FE:
+        _breaker_key = staticmethod(lambda wid: wid)
+
+        def statusz(self):
+            return {"shards": {
+                "0": {"queue_depth": 97, "queue_bound": 100},
+                "1": {"queue_depth": 3, "queue_bound": 100}}}
+
+    d = _mk_daemon(config=_cfg(join_host="spare"), frontend=_FE(),
+                   membership=_MC(),
+                   replicate_fn=lambda s: calls["repl"].append(s),
+                   clock=lambda: 100.0)
+    d.tick()
+    d.actuators.stop()                   # join runs on a worker thread
+    assert calls["join"] == ["spare"]
+    assert calls["repl"] == [0]          # shard 0 holds 97% of queue
+
+
+def test_scale_advise_books_without_budget():
+    class _FE:
+        _breaker_key = staticmethod(lambda wid: wid)
+
+        def statusz(self):
+            return {"shards": {
+                "0": {"queue_depth": 95, "queue_bound": 100}}}
+
+    d = _mk_daemon(config=_cfg(budget=1), frontend=_FE(),
+                   clock=lambda: 100.0)
+    d.budget.book(100.0)
+    a0 = daemon_mod.M_SCALE_ADVISED.value
+    obs_recorder.drain_pending()
+    d.tick()
+    assert daemon_mod.M_SCALE_ADVISED.value == a0 + 1
+    assert any(e["kind"] == "control_scale_advise"
+               for e in _drain_control_events())
+
+
+# ------------------------------------------------- breaker pin seam
+
+def test_breaker_force_open_pins_against_healing():
+    br = CircuitBreaker(("h", 0), threshold=3, cooldown_s=0.01,
+                        clock=time.monotonic)
+    open0 = _counter("head_circuit_open_total")
+    br.force_open("quarantine test")
+    assert br.state == OPEN and br.pinned
+    assert _counter("head_circuit_open_total") == open0 + 1
+    assert not br.allow() and not br.would_allow()
+    # a success result cannot heal a pinned breaker
+    br.record(True)
+    assert br.state == OPEN
+    # cooldown elapsed: half-open trial still refused
+    time.sleep(0.02)
+    assert not br.allow()
+    br.force_open("again")               # idempotent
+    br.release(close=True)
+    assert br.state != OPEN and not br.pinned
+    assert br.allow()
+
+
+def test_registry_force_open_release_and_disabled_noop():
+    reg = BreakerRegistry(threshold=3, cooldown_s=60.0, enabled=True)
+    assert reg.force_open(("h", 1), why="t")
+    assert not reg.allow(("h", 1))
+    assert reg.snapshot()[str(("h", 1))]["pinned"]
+    reg.release(("h", 1), close=True)
+    assert reg.allow(("h", 1))
+    assert not reg.snapshot()[str(("h", 1))]["pinned"]
+    off = BreakerRegistry(enabled=False)
+    assert off.force_open(("h", 1)) is False
+    assert off.allow(("h", 1))
+    reg.shutdown()
+    off.shutdown()
+
+
+# ------------------------------------------------ supervisor kick seam
+
+def _conf(n=2):
+    return ClusterConfig(workers=["localhost"] * n, partmethod="mod",
+                         partkey=n)
+
+
+def _dummy_spawn(w):
+    return subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(600)"],
+                            start_new_session=True)
+
+
+def _alive_probe(w):
+    if w.proc is not None and w.proc.poll() is None:
+        return HealthStatus(ok=True, wid=w.wid)
+    return None
+
+
+def test_kick_schedules_immediate_respawn_past_backoff():
+    """kick() must overwrite an already-scheduled exponential backoff
+    wait: with a 5 s base the respawn would otherwise be unobservable
+    in this test's 2 s window."""
+    sup = WorkerSupervisor(_conf(1), conf_path=None,
+                           spawn_fn=_dummy_spawn, probe_fn=_alive_probe,
+                           ping_interval_s=0.05, backoff_base_s=5.0,
+                           backoff_cap_s=10.0)
+    sup.start(wait_ready_s=10)
+    try:
+        w = sup.workers[0]
+        w.proc.kill()
+        w.proc.wait()
+        # let the monitor OBSERVE the death and schedule the 5 s wait
+        deadline = time.monotonic() + 5
+        while w.next_spawn_at == 0.0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert w.next_spawn_at > 0.0
+        assert sup.kick(0) is True       # dead: immediate respawn
+        deadline = time.monotonic() + 2
+        while w.respawns == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert w.respawns == 1
+        assert w.proc.poll() is None
+        assert sup.kick(0) is False      # alive now: nothing to do
+        assert sup.kick(99) is False     # unknown wid: no-op
+    finally:
+        sup.stop()
+
+
+# ------------------------------ satellite: hung worker, opt-in respawn
+
+def test_hung_worker_respawn_driven_through_quarantine_decision(
+        monkeypatch, tmp_path):
+    """The opt-in hung-worker path end to end: a delay-faulted worker
+    stays ping-ALIVE as a process but unhealthy on the wire; the
+    policy's quarantine decision fires on its ping failures and kicks,
+    while the supervisor's DOS_SUPERVISOR_UNHEALTHY_PINGS escalation
+    kills and respawns it; the fault budget then runs dry, probes come
+    back clean, and the daemon re-admits — zero operator action."""
+    from distributed_oracle_search_tpu.testing import faults
+
+    faults.reset()
+    monkeypatch.setenv("DOS_FAULTS", "delay;wid=0;times=6;delay=9")
+    monkeypatch.setenv("DOS_FAULTS_STATE",
+                       str(tmp_path / "faults.json"))
+    monkeypatch.setenv("DOS_SUPERVISOR_UNHEALTHY_PINGS", "3")
+
+    def probe(w):
+        # the delay fault models a hung server: the process is alive
+        # but a ping would block past its timeout -> failure
+        if faults.inject("delay", w.wid) is not None:
+            return None
+        return _alive_probe(w)
+
+    sup = WorkerSupervisor(_conf(1), conf_path=None,
+                           spawn_fn=_dummy_spawn, probe_fn=probe,
+                           ping_interval_s=0.05, backoff_base_s=0.05,
+                           backoff_cap_s=0.2)
+    assert sup.unhealthy_pings == 3      # env knob armed
+    reg = _SpyRegistry()
+    d = _mk_daemon(config=_cfg(unhealthy_pings=2, clean_probes=2),
+                   supervisor=sup, registry=reg,
+                   breaker_key=lambda w: ("localhost", w))
+    w = sup.workers[0]
+    w.proc = _dummy_spawn(w)
+    w.healthy_once = True
+    first_pid = w.proc.pid
+    t = threading.Thread(target=sup._monitor, daemon=True,
+                         name="dos-supervisor")
+    t.start()
+    try:
+        # tick until the daemon quarantines on the ping-failure signal
+        deadline = time.monotonic() + 10
+        while not reg.forced and time.monotonic() < deadline:
+            d.tick()
+            time.sleep(0.05)
+        assert reg.forced and reg.forced[0][0] == ("localhost", 0)
+        assert "ping failures" in reg.forced[0][1]
+        # the supervisor's own opt-in escalation kills the hung proc
+        # and respawns it
+        deadline = time.monotonic() + 10
+        while w.respawns == 0 and time.monotonic() < deadline:
+            d.tick()
+            time.sleep(0.05)
+        assert w.respawns >= 1
+        assert w.proc.pid != first_pid
+        # fault budget (times=6) exhausts; pings heal; the daemon's
+        # probation probes run clean and re-admit
+        deadline = time.monotonic() + 15
+        while not reg.released and time.monotonic() < deadline:
+            d.tick()
+            time.sleep(0.05)
+        assert reg.released == [(("localhost", 0), True)]
+        assert d.quarantine.quarantined() == []
+    finally:
+        sup._stop.set()
+        t.join(timeout=5)
+        if w.proc is not None and w.proc.poll() is None:
+            w.proc.kill()
+            w.proc.wait()
+        sup_mod.G_ALIVE.set(0)
+        faults.reset()
+
+
+# --------------------------------------- plan_leave live-set semantics
+
+def _mc(tmp_path, n=3, nodes=9, replication=1):
+    import types
+
+    dc = DistributionController("mod", n, n, nodes,
+                                replication=replication)
+    conf = types.SimpleNamespace(workers=[f"h{i}" for i in range(n)],
+                                 outdir=str(tmp_path))
+    return fleet.MembershipController(conf, dc)
+
+
+def test_plan_leave_refuses_sole_owner_with_no_live_chain(tmp_path):
+    """R=1: the leaver is each of its shards' ONLY replica-chain host.
+    With a live set (leaver presumed dead — catch-up cannot copy from
+    a corpse) the plan must refuse with a per-shard diagnostic and a
+    counter, leaving membership untouched."""
+    mc = _mc(tmp_path, replication=1)
+    owners0 = list(mc.state.owners)
+    refused0 = _counter("reshard_leave_refused_total")
+    with pytest.raises(ValueError, match=r"refusing leave of worker 1"):
+        mc.plan_leave(1, live={0, 2})
+    with pytest.raises(ValueError, match=r"shard 1: .*no live host"):
+        mc.plan_leave(1, live={0, 2})
+    with pytest.raises(ValueError, match=r"sole owner at R=1"):
+        mc.plan_leave(1, live={0, 2})
+    assert _counter("reshard_leave_refused_total") == refused0 + 3
+    assert mc.state.owners == owners0            # nothing committed
+    # legacy live=None path still round-robins onto surviving owners
+    mig = mc.plan_leave(1)
+    assert mig.moves and all(to in (0, 2) for _s, _f, to in mig.moves)
+
+
+def test_plan_leave_live_set_adopts_only_live_replica_hosts(tmp_path):
+    mc = _mc(tmp_path, replication=2)
+    dc = mc.dc_view()
+    mig = mc.plan_leave(1, live={0, 2})
+    assert mig.moves
+    for shard, frm, to in mig.moves:
+        assert frm == 1 and to in (0, 2)
+        assert to in dc.replica_workers(shard)   # already holds rows
+    # same fleet, but the only replica host is itself dead: refuse
+    chain_hosts = {h for s, _f, _t in mig.moves
+                   for h in dc.replica_workers(s) if h != 1}
+    dead_live = {0, 2} - chain_hosts
+    if chain_hosts != {0, 2}:
+        with pytest.raises(ValueError):
+            mc.plan_leave(1, live=dead_live)
+
+
+def test_plan_leave_refuses_when_no_live_owner_remains(tmp_path):
+    mc = _mc(tmp_path, replication=1)
+    refused0 = _counter("reshard_leave_refused_total")
+    with pytest.raises(ValueError, match="last shard-owning"):
+        mc.plan_leave(1, live=set())
+    assert _counter("reshard_leave_refused_total") == refused0 + 1
+
+
+# ------------------------------------------- frontend brownout seams
+
+def _fe(n=1, **sconf_kw):
+    import numpy as np
+
+    from distributed_oracle_search_tpu.serving import (
+        CallableDispatcher, ServeConfig, ServingFrontend,
+    )
+    from distributed_oracle_search_tpu.serving.hedge import HedgeConfig
+
+    dc = DistributionController("mod", n, n, 8 * n)
+
+    def fn(wid, q, rconf, diff):
+        k = len(q)
+        return (np.zeros(k, np.int64), np.zeros(k, np.int64),
+                np.ones(k, bool))
+
+    sconf_kw.setdefault("max_batch", 8)
+    sconf_kw.setdefault("max_wait_ms", 1.0)
+    sconf_kw.setdefault("deadline_ms", 8000.0)
+    return ServingFrontend(dc, CallableDispatcher(fn),
+                           sconf=ServeConfig(**sconf_kw).validate(),
+                           hconf=HedgeConfig(enabled=True, budget=0.2))
+
+
+def test_brownout_ladder_applies_and_restores_pristine_knobs():
+    fe = _fe()
+    act = Actuators(frontend=fe)
+    budget0 = fe.hedge.config.budget
+    deadline0 = fe.sconf.deadline_ms
+    act.apply_brownout(1)
+    assert fe.hedge.config.budget == pytest.approx(budget0 * 0.25)
+    assert fe.shed_families == frozenset()
+    assert fe.sconf.deadline_ms == deadline0
+    act.apply_brownout(2)
+    assert fe.shed_families == frozenset(BROWNOUT_SHED_FAMILIES)
+    assert fe.sconf.deadline_ms == deadline0
+    act.apply_brownout(3)
+    assert fe.sconf.deadline_ms == pytest.approx(deadline0 * 0.25)
+    assert fe.statusz()["shed_families"] == ["alt", "mat"]
+    # stepping down restores EXACTLY the pristine values
+    act.apply_brownout(0)
+    assert fe.hedge.config.budget == budget0
+    assert fe.sconf.deadline_ms == deadline0
+    assert fe.shed_families == frozenset()
+    assert "shed_families" not in fe.statusz()   # legacy body restored
+
+
+def test_family_shed_answers_busy_while_pairs_flow():
+    from distributed_oracle_search_tpu.serving import BUSY
+    from distributed_oracle_search_tpu.traffic.families import (
+        QueryFamilies,
+    )
+
+    fe = _fe()
+    fe.start()
+    try:
+        fam = QueryFamilies(fe)
+        shed0 = _counter("serve_shed_family_total")
+        fe.set_family_shed(("mat", "alt"))
+        f = fam.submit_line("mat", [0, [1, 2]])
+        assert f.done()                          # shed in-order, now
+        r = f.result(0)
+        assert r.status == BUSY and r.detail == "brownout-shed"
+        assert _counter("serve_shed_family_total") == shed0 + 1
+        # plain reverse queries keep flowing
+        rr = fam.submit_line("rev", [1, 2]).result(10)
+        assert rr.ok
+        # and clearing the shed restores the family
+        fe.set_family_shed(())
+        assert fam.submit_line("mat", [0, [1]]).result(10).ok
+    finally:
+        fe.stop()
+
+
+def test_control_off_frontend_statusz_byte_identical():
+    fe = _fe()
+    assert "shed_families" not in fe.statusz()
+    assert fe.shed_families == frozenset()
+
+
+# -------------------------------------------- obs: columns, directions
+
+def test_fleet_summary_control_columns_blank_tolerant():
+    row = obs_fleet._summarize({
+        "control": {"brownout_level": 2, "dry_run": True,
+                    "last_action": "quarantine(executed) wid=1",
+                    "quarantined": [1, 3]},
+        "worker": {"batches": 1}})
+    assert row["policy"] == "dry:L2"
+    assert row["last action"] == "quarantine(executed)"
+    assert row["quarantined"] == "1,3"
+    live = obs_fleet._summarize({"control": {"brownout_level": 0,
+                                             "dry_run": False}})
+    assert live["policy"] == "L0"
+    # endpoints without the section (or with garbage) show no columns
+    for status in ({}, {"control": {}}, {"control": "nope"},
+                   {"control": {"brownout_level": True,
+                                "last_action": 7,
+                                "quarantined": "x"}}):
+        row = obs_fleet._summarize(status)
+        assert "policy" not in row
+        assert "last action" not in row
+        assert "quarantined" not in row
+
+
+def test_bench_directions_and_tolerances_cover_control_family():
+    for k in ("control_recover_seconds", "control_shed_rate",
+              "control_p99_ms", "control_off_recover_seconds",
+              "control_off_shed_rate", "control_off_p99_ms"):
+        assert obs_fleet._KEY_DIRECTIONS.get(k) == "lower", k
+        assert k in obs_fleet._KEY_TOLERANCES, k
+    # the suffix heuristic alone would misread the _rate keys as
+    # higher-is-better — that is WHY they are pinned here
+    assert not k.endswith(("_ms", "_seconds", "_s")) or True
+
+
+def test_daemon_statusz_shape():
+    d = _mk_daemon(clock=lambda: 50.0)
+    st = d.statusz()
+    assert st["enabled"] is True and st["dry_run"] is False
+    assert st["brownout_level"] == 0 and st["quarantined"] == []
+    assert st["budget"]["used"] == 0
+
+
+# --------------------------------------------- core drill (non-slow)
+
+def test_core_drill_kill_quarantine_respawn_readmit(tmp_path):
+    """The closed loop end to end against a real supervisor + real
+    breaker registry: a worker is killed, the daemon quarantines it
+    (breaker pinned, kick scheduled), the supervisor respawns it, the
+    probation probes run clean, and the daemon re-admits — with the
+    whole causal chain on the flight recorder."""
+    rec = obs_recorder.FlightRecorder(str(tmp_path / "tape"),
+                                      flush_every=1)
+    obs_recorder.set_recorder(rec)
+    reg = BreakerRegistry(threshold=3, cooldown_s=60.0, enabled=True)
+    sup = WorkerSupervisor(_conf(2), conf_path=None,
+                           spawn_fn=_dummy_spawn, probe_fn=_alive_probe,
+                           ping_interval_s=0.05, backoff_base_s=5.0,
+                           backoff_cap_s=10.0)
+    d = _mk_daemon(config=_cfg(clean_probes=2), supervisor=sup,
+                   registry=reg, breaker_key=lambda w: ("localhost", w))
+    actions0 = daemon_mod.M_ACTIONS.value
+    sup.start(wait_ready_s=10)
+    try:
+        w = sup.workers[0]
+        w.proc.kill()
+        w.proc.wait()
+        deadline = time.monotonic() + 10
+        while (d.quarantine.quarantined() != [0]
+               and time.monotonic() < deadline):
+            d.tick()
+            time.sleep(0.05)
+        assert d.quarantine.quarantined() == [0]
+        assert not reg.allow(("localhost", 0))   # routed around
+        # kick beat the 5 s backoff: the respawn lands fast
+        deadline = time.monotonic() + 5
+        while w.respawns == 0 and time.monotonic() < deadline:
+            d.tick()
+            time.sleep(0.05)
+        assert w.respawns == 1
+        deadline = time.monotonic() + 10
+        while (d.quarantine.quarantined()
+               and time.monotonic() < deadline):
+            d.tick()
+            time.sleep(0.05)
+        assert d.quarantine.quarantined() == []
+        assert reg.allow(("localhost", 0))       # breaker released
+        assert sup.workers[1].respawns == 0      # survivor untouched
+        assert daemon_mod.M_ACTIONS.value > actions0
+    finally:
+        sup.stop()
+        reg.shutdown()
+        obs_recorder.set_recorder(None)
+    rec.close()
+    # satellite: dos-obs replay renders the causal incident timeline
+    records = obs_recorder.replay(str(tmp_path / "tape"))
+    kinds = [r["kind"] for r in records if r.get("rec") == "event"]
+    assert "control_quarantine" in kinds and "control_readmit" in kinds
+    assert (kinds.index("control_quarantine")
+            < kinds.index("control_readmit"))
+    text = obs_recorder.render_timeline(records)
+    assert "control_quarantine" in text and "control_readmit" in text
+
+
+def test_daemon_thread_lifecycle():
+    d = ControlDaemon(_cfg(interval_s=0.05), clock=time.monotonic)
+    t0 = daemon_mod.M_TICKS.value
+    d.start()
+    try:
+        assert "dos-control" in [t.name for t in threading.enumerate()]
+        deadline = time.monotonic() + 5
+        while (daemon_mod.M_TICKS.value == t0
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert daemon_mod.M_TICKS.value > t0
+    finally:
+        d.stop()
+    assert "dos-control" not in [t.name for t in threading.enumerate()
+                                 if t.is_alive()]
