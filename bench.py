@@ -34,6 +34,10 @@ grid + arterials; see ``data/synth.py``). Sections (env-gated):
              then an open-loop Poisson drill at a fraction of measured
              capacity — q/s, p50/p95/p99 latency, zipf cache hit rate,
              mean micro-batch fill                   (BENCH_SERVE=0 skips)
+  gateway    rush hour on the gateway tier (gateway/): 2 binary-protocol
+             frontend replicas over one worker vs the single-head line
+             protocol — aggregate q/s, per-frontend fairness, fleet
+             L1+L2 cache hit rate, answer parity  (BENCH_GATEWAY=0 skips)
   replication  R=2 failover drill — q/s + p99 with and without one
              killed primary (breaker forced open), plus hedge win rate
              under an injected primary delay          (BENCH_REPL=0 skips)
@@ -2246,6 +2250,267 @@ def main() -> None:
             f"{rpc_stats['serve_rpc_queries_per_sec']:,.0f} vs "
             f"{rpc_stats['serve_fifo_queries_per_sec']:,.0f} q/s")
 
+    # ---- gateway tier section: rush hour on the binary client
+    # protocol — two stateless frontend replicas over the SAME worker
+    # (gateway/ frames, credit windows, per-replica L1 + shard-owner
+    # L2) vs the single-head line-protocol serve on one zipf-skewed
+    # pool. Reports aggregate q/s, per-frontend fairness (max/min),
+    # and the fleet's two-level cache hit rate vs the single head's;
+    # answers must be bit-identical between the lanes. BENCH_GATEWAY=0
+    # skips.
+    gateway_stats = {}
+    if os.environ.get("BENCH_GATEWAY", "1") != "0":
+        import queue as _gqueue
+        import socket as _gsocket
+        import threading as _gthreading
+
+        from distributed_oracle_search_tpu.data import (
+            ensure_synth_dataset, read_scen,
+        )
+        from distributed_oracle_search_tpu.data.graph import Graph
+        from distributed_oracle_search_tpu.gateway import (
+            DosClient, GatewayConfig, GatewayTier,
+        )
+        from distributed_oracle_search_tpu.gateway import (
+            client as gateway_client,
+        )
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            RpcDispatcher, ServeConfig, ServingFrontend,
+        )
+        from distributed_oracle_search_tpu.serving import ingress
+        from distributed_oracle_search_tpu.transport.wire import (
+            RuntimeConfig,
+        )
+        from distributed_oracle_search_tpu.utils.config import (
+            ClusterConfig,
+        )
+        from distributed_oracle_search_tpu.worker import (
+            FifoServer, stop_server,
+        )
+        from distributed_oracle_search_tpu.worker.server import (
+            RpcServeLoop,
+        )
+
+        log("gateway tier (2 binary-protocol frontends vs single-head "
+            "line protocol, same worker)...")
+        gdir = tempfile.mkdtemp(prefix="bench-gw-")
+        _genv = {k: os.environ.get(k) for k in
+                 ("DOS_RPC_SOCKET_DIR", "DOS_GATEWAY_L2_BYTES")}
+        os.environ["DOS_RPC_SOCKET_DIR"] = gdir
+        os.environ["DOS_GATEWAY_L2_BYTES"] = str(1 << 20)
+        gpaths = ensure_synth_dataset(gdir, width=24, height=18,
+                                      n_queries=512, seed=41)
+        gcfg = ClusterConfig(
+            workers=["localhost"], partmethod="mod", partkey=1,
+            outdir=os.path.join(gdir, "index"), xy_file=gpaths["xy"],
+            scenfile=gpaths["scen"], nfs=gdir).validate()
+        gg = Graph.from_xy(gcfg.xy_file)
+        gdc = DistributionController("mod", 1, 1, gg.n)
+        build_worker_shard(gg, gdc, 0, gcfg.outdir)
+        write_index_manifest(gcfg.outdir, gdc)
+        gqueries = read_scen(gcfg.scenfile)
+        gfifo = os.path.join(gdir, "gw-worker0.fifo")
+        gwsrv = FifoServer(gcfg, 0, command_fifo=gfifo)
+        gwth = _gthreading.Thread(target=gwsrv.serve_forever,
+                                  daemon=True)
+        gwth.start()
+        for _ in range(200):
+            if os.path.exists(gfifo):
+                break
+            time.sleep(0.02)
+        gloop = RpcServeLoop(gwsrv).start()
+        grc = RuntimeConfig()
+        gn = int(os.environ.get("BENCH_GATEWAY_REQUESTS", 4096))
+        gb = int(os.environ.get("BENCH_GATEWAY_BATCH", 64))
+        grng = np.random.default_rng(23)
+        gpool = gqueries[grng.zipf(1.3, size=gn)
+                         .clip(1, len(gqueries)) - 1]
+        # warm the worker engine's compiled shapes off every clock
+        gwsrv.engine.answer(gqueries[:gb], grc, "-")
+
+        def _gfe():
+            fe = ServingFrontend(
+                gdc, RpcDispatcher(gcfg, timeout=120.0),
+                sconf=ServeConfig(queue_depth=max(gn, 1024),
+                                  max_batch=gb, max_wait_ms=2.0,
+                                  deadline_ms=600_000.0,
+                                  cache_bytes=1 << 20).validate())
+            fe.start()
+            return fe
+
+        def _line_row(line):
+            # OK <s> <t> <cost> <plen> <finished> [cached]
+            toks = line.split()
+            if len(toks) >= 6 and toks[0] == "OK":
+                return (toks[0], int(toks[3]), int(toks[4]),
+                        bool(int(toks[5])))
+            return (toks[0] if toks else "ERROR", -1, -1, False)
+
+        gclients = []
+        tier = None
+        gfes = []
+        try:
+            # -- single head: the legacy line-protocol lane, fully
+            # pipelined (writer thread keeps lines flowing while the
+            # replies stream back in order)
+            fe0 = _gfe()
+            gfes.append(fe0)
+            glsock = os.path.join(gdir, "line.sock")
+            glstop = _gthreading.Event()
+            glth = _gthreading.Thread(
+                target=ingress.serve_unix_socket, args=(fe0, glsock),
+                kwargs={"stop": glstop}, daemon=True)
+            glth.start()
+            for _ in range(200):
+                if os.path.exists(glsock):
+                    break
+                time.sleep(0.02)
+            gcs = _gsocket.socket(_gsocket.AF_UNIX,
+                                  _gsocket.SOCK_STREAM)
+            gcs.connect(glsock)
+            gcrf = gcs.makefile("r")
+            gcwf = gcs.makefile("w")
+
+            def _drive_line(part):
+                def _pump():
+                    for s, t in part:
+                        gcwf.write(f"{int(s)} {int(t)}\n")
+                    gcwf.flush()
+
+                rows = []
+                w = _gthreading.Thread(target=_pump, daemon=True)
+                t0 = time.perf_counter()
+                w.start()
+                for _ in range(len(part)):
+                    rows.append(_line_row(gcrf.readline()))
+                w.join()
+                return time.perf_counter() - t0, rows
+
+            _drive_line(gpool[:gb])          # warm lane + L1 + shapes
+            h0, m0 = fe0.cache.hits, fe0.cache.misses
+            l2h0, l2m0 = gwsrv.l2.hits, gwsrv.l2.misses
+            single_wall, base_rows = _drive_line(gpool)
+            single_hits = ((fe0.cache.hits - h0)
+                           + (gwsrv.l2.hits - l2h0))
+            gcwf.write("quit\n")
+            gcwf.flush()
+            gcs.close()
+            glstop.set()
+            glth.join(timeout=10)
+            fe0.stop()
+
+            # -- the tier: 2 replicas, 2 clients, batched query frames.
+            # The single head's L2 entries are flushed first — the
+            # fleet hit rate must be earned by THIS lane's traffic
+            gwsrv.l2.invalidate()
+            gfes = [fe0] + [_gfe() for _ in range(2)]
+            fes = gfes[1:]
+            ggconf = GatewayConfig(
+                replicas=2, socket_dir=gdir, credit=64,
+                deadline_ms=600_000.0).validate()
+            tier = GatewayTier([(fe, None) for fe in fes],
+                               gconf=ggconf).start()
+            gclients = [DosClient(ep) for ep in tier.endpoints]
+            ghalves = [gpool[0::2], gpool[1::2]]
+            for c, half in zip(gclients, ghalves):   # warm, off-clock
+                c.query_batch([(int(s), int(t)) for s, t in half[:gb]],
+                              timeout=600.0)
+            gh0 = [(fe.cache.hits, fe.cache.misses) for fe in fes]
+            gl2h0 = gwsrv.l2.hits
+            gwalls = [0.0, 0.0]
+            grows = [[], []]
+
+            def _drive_gw(k):
+                # open loop: a pump thread keeps the credit window
+                # full while this thread collects replies in
+                # submission order — the frame-level twin of the line
+                # lane's pipelined writer
+                c, half = gclients[k], ghalves[k]
+                fidq = _gqueue.Queue()
+
+                def _pump():
+                    for i in range(0, len(half), gb):
+                        batch = [(int(s), int(t))
+                                 for s, t in half[i:i + gb]]
+                        fidq.put(c.submit_pairs(batch, timeout=600.0))
+                    fidq.put(None)
+
+                t0 = time.perf_counter()
+                w = _gthreading.Thread(target=_pump, daemon=True)
+                w.start()
+                while True:
+                    fid = fidq.get()
+                    if fid is None:
+                        break
+                    rows = gateway_client.pair_rows(
+                        c.wait(fid, timeout=600.0))
+                    grows[k].extend((st, cost, plen, fin) for st, cost,
+                                    plen, fin, _cached in rows)
+                w.join()
+                gwalls[k] = time.perf_counter() - t0
+
+            gths = [_gthreading.Thread(target=_drive_gw, args=(k,))
+                    for k in range(2)]
+            t0 = time.perf_counter()
+            for th in gths:
+                th.start()
+            for th in gths:
+                th.join()
+            tier_wall = time.perf_counter() - t0
+            fleet_hits = (sum(fe.cache.hits - h for fe, (h, _m)
+                              in zip(fes, gh0))
+                          + (gwsrv.l2.hits - gl2h0))
+        finally:
+            for c in gclients:
+                c.close()
+            if tier is not None:
+                tier.stop()
+            for fe in gfes[1:]:
+                fe.stop()
+            stop_server(gfifo, deadline_s=5.0)
+            gwth.join(timeout=15)
+            gloop.stop()
+            shutil.rmtree(gdir, ignore_errors=True)
+            for k, v in _genv.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        gw_rows = [None] * gn
+        gw_rows[0::2] = grows[0]
+        gw_rows[1::2] = grows[1]
+        matches = sum(a == b for a, b in zip(base_rows, gw_rows))
+        per_fe_qps = [len(h) / max(w, 1e-9)
+                      for h, w in zip(ghalves, gwalls)]
+        gateway_stats = {
+            "gateway_aggregate_queries_per_sec": round(
+                gn / tier_wall, 1),
+            "gateway_single_head_queries_per_sec": round(
+                gn / single_wall, 1),
+            "gateway_vs_single_head_ratio": round(
+                single_wall / tier_wall, 2),
+            "gateway_fairness_ratio": round(
+                max(per_fe_qps) / max(min(per_fe_qps), 1e-9), 2),
+            "gateway_answers_match": round(matches / gn, 4),
+            "gateway_fleet_cache_hit_rate": round(fleet_hits / gn, 3),
+            "gateway_single_head_cache_hit_rate": round(
+                single_hits / gn, 3),
+        }
+        log(f"gateway: tier "
+            f"{gateway_stats['gateway_aggregate_queries_per_sec']:,.0f}"
+            f" q/s vs single head "
+            f"{gateway_stats['gateway_single_head_queries_per_sec']:,.0f}"
+            f" q/s ({gateway_stats['gateway_vs_single_head_ratio']}x), "
+            f"fairness {gateway_stats['gateway_fairness_ratio']}x, "
+            f"answers match {gateway_stats['gateway_answers_match']:.2%}"
+            f", fleet cache "
+            f"{gateway_stats['gateway_fleet_cache_hit_rate']:.0%} vs "
+            f"single "
+            f"{gateway_stats['gateway_single_head_cache_hit_rate']:.0%}")
+
     # ---- telemetry section: the fleet telemetry bus priced in
     # isolation — publish-side tick cost (what the bus adds to every
     # resident process each DOS_TELEMETRY_INTERVAL_S; the acceptance
@@ -3002,6 +3267,7 @@ def main() -> None:
         **multichip_stats,
         **serve_stats,
         **rpc_stats,
+        **gateway_stats,
         **telemetry_stats,
         **repl_stats,
         **reshard_stats,

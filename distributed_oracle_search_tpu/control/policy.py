@@ -301,10 +301,20 @@ class RepairScaler:
 
     def decide(self, sig, now: float) -> list[tuple]:
         out = []
-        # queue_frac is only an observation when the frontend reported
-        # shards at all (0.0 from an absent sensor must hold state, but
-        # a genuinely drained fleet must be able to clear the rule)
-        frac = sig.queue_frac if sig.queue_depths else None
+        # starvation evidence comes from BOTH admission sensors when
+        # present: shard queue saturation (FIFO/engine lanes queue in
+        # the frontend) and RPC credit-window occupancy (streaming
+        # lanes queue in the worker — a starved RPC fleet shows full
+        # windows, not deep frontend queues). Either alone is an
+        # observation; neither reporting holds the rule's state (0.0
+        # from an absent sensor must not clear it, but a genuinely
+        # drained fleet must be able to)
+        evidence = []
+        if sig.queue_depths:
+            evidence.append(sig.queue_frac)
+        if getattr(sig, "credit_occupancy", None):
+            evidence.append(sig.credit_frac)
+        frac = max(evidence) if evidence else None
         if self._starve.observe(frac, now) == "trip":
             if self.join_host:
                 out.append(("join", self.join_host))

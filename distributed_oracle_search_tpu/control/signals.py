@@ -35,6 +35,13 @@ class ControlSignals:
     queue_frac: float = 0.0
     #: per-shard queue depth {wid: depth} from the frontend
     queue_depths: dict = dataclasses.field(default_factory=dict)
+    #: per-lane RPC credit-window occupancy {via: frac} from the
+    #: frontend's streaming-transport connection table
+    credit_occupancy: dict = dataclasses.field(default_factory=dict)
+    #: max credit occupancy across lanes (0.0 idle) — full windows are
+    #: the streaming lane's starvation signal: queues live in the
+    #: WORKER under RPC, so frontend queue depth alone under-reports
+    credit_frac: float = 0.0
     #: per-worker process liveness {wid: bool} from the supervisor
     worker_running: dict = dataclasses.field(default_factory=dict)
     #: per-worker consecutive ping failures {wid: int}
@@ -99,6 +106,18 @@ class SignalReader:
             return
         try:
             st = self.frontend.statusz()
+            transport = st.get("transport")
+            conns = (transport.get("connections")
+                     if isinstance(transport, dict) else None)
+            if isinstance(conns, dict):
+                for via, c in conns.items():
+                    occ = (c.get("occupancy")
+                           if isinstance(c, dict) else None)
+                    if isinstance(occ, (int, float)):
+                        sig.credit_occupancy[int(via)] = float(occ)
+                if sig.credit_occupancy:
+                    sig.credit_frac = max(
+                        sig.credit_occupancy.values())
             shards = st.get("shards")
             if not isinstance(shards, dict):
                 return

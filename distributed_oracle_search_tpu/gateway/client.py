@@ -1,0 +1,261 @@
+"""``DosClient`` — the client library for the gateway tier.
+
+One persistent connection per client: the constructor connects, reads
+the gateway ``hello`` (gating on a NEWER schema, tolerating older),
+and sizes a local credit semaphore to the advertised window so the
+client can never trip the gateway's BUSY answer under its own steam — a
+``busy`` frame still surfaces (another client may have the window) as
+:class:`GatewayBusy`, which is retryable by contract.
+
+Frames multiplex: ``submit_*`` returns a handle immediately and a
+background reader correlates reply frames back by ``id``, so a caller
+can keep the whole credit window full (the bench's open-loop driver
+does; :func:`pair_rows` decodes a reply frame it collected itself).
+The sync conveniences (``query``, ``matrix``, ``alternatives``,
+``reverse``) are submit + wait.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from . import protocol
+from ..transport.frames import (FrameReader, FrameWriter,
+                                FrameSchemaError, TornFrame,
+                                TransportError)
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class GatewayBusy(Exception):
+    """The gateway answered ``busy`` — the frame was shed at the credit
+    window, nothing was enqueued; retry after backoff."""
+
+
+class GatewayError(Exception):
+    """The gateway answered a typed ``err`` frame."""
+
+
+class _Slot:
+    __slots__ = ("ev", "frame")
+
+    def __init__(self):
+        self.ev = threading.Event()
+        self.frame = None
+
+
+class DosClient:
+    """One connection to one gateway replica (see module docstring)."""
+
+    def __init__(self, endpoint: str, max_inflight: int | None = None,
+                 connect_timeout_s: float = 5.0):
+        self.endpoint = endpoint
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(connect_timeout_s)
+        sock.connect(endpoint)
+        sock.settimeout(None)
+        self._sock = sock
+        self._writer = FrameWriter(sock)
+        self._reader = FrameReader(sock)
+        hello = self._reader.read()
+        if hello is None or hello.kind != "hello":
+            raise TransportError(f"gateway {endpoint} sent no hello")
+        protocol.check_hello(hello.header)   # gate-newer, tolerate-older
+        self.frontend = int(hello.header.get("frontend", -1))
+        self.epoch = int(hello.header.get("epoch", 0))
+        self.diff_epoch = int(hello.header.get("diff_epoch", 0))
+        server_credit = int(hello.header.get("credit", 1))
+        self.credit = max(1, min(server_credit,
+                                 max_inflight or server_credit))
+        self._credits = threading.Semaphore(self.credit)
+        self._slots: dict[int, _Slot] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        self._writer.send({"kind": "hello",
+                           "gv": protocol.GATEWAY_SCHEMA_VERSION})
+        self._rthread = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"dos-client-{self.frontend}")
+        self._rthread.start()
+
+    # ----------------------------------------------------------- plumbing
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                fr = self._reader.read()
+                if fr is None:
+                    break
+                fid = protocol.frame_id(fr)
+                with self._lock:
+                    slot = self._slots.get(fid)
+                if slot is None:
+                    log.debug("gateway client: unmatched frame id %d "
+                              "kind %r", fid, fr.kind)
+                    continue
+                slot.frame = fr
+                slot.ev.set()
+                # the credit returns when the REPLY lands, not when a
+                # waiter collects it — a caller that timed out early
+                # must not leak its window slot forever
+                self._credits.release()
+        except (TransportError, TornFrame, FrameSchemaError,
+                OSError) as e:
+            log.debug("gateway client reader down: %s", e)
+        finally:
+            with self._lock:
+                slots, self._slots = self._slots, {}
+            for slot in slots.values():
+                if not slot.ev.is_set():
+                    slot.ev.set()   # frame stays None → TransportError
+                    self._credits.release()
+
+    def _submit(self, build, timeout: float | None = None) -> int:
+        """Acquire one credit, send one frame built by ``build(fid)``;
+        returns the frame id to :meth:`wait` on."""
+        if self._closed:
+            raise TransportError("client closed")
+        if not self._credits.acquire(timeout=timeout):
+            raise GatewayBusy("local credit window exhausted")
+        with self._lock:
+            fid = self._next_id
+            self._next_id += 1
+            self._slots[fid] = _Slot()
+        try:
+            header, arrays = build(fid)
+            self._writer.send(header, arrays)
+        except Exception:
+            with self._lock:
+                self._slots.pop(fid, None)
+            self._credits.release()
+            raise
+        return fid
+
+    def wait(self, fid: int, timeout: float | None = None):
+        """Block for frame ``fid``'s reply; returns the decoded frame.
+        Raises :class:`GatewayBusy` on a ``busy`` answer,
+        :class:`GatewayError` on a typed ``err``, ``TransportError``
+        when the connection died first."""
+        with self._lock:
+            slot = self._slots.get(fid)
+        if slot is None:
+            raise KeyError(f"no in-flight frame {fid}")
+        if not slot.ev.wait(timeout):
+            raise TimeoutError(f"gateway reply {fid} still pending")
+        with self._lock:
+            self._slots.pop(fid, None)
+        fr = slot.frame
+        if fr is None:
+            raise TransportError("gateway connection closed mid-flight")
+        self.epoch = int(fr.header.get("epoch", self.epoch))
+        self.diff_epoch = int(fr.header.get("diff_epoch",
+                                            self.diff_epoch))
+        if fr.kind == "busy":
+            raise GatewayBusy(f"gateway shed frame {fid}")
+        if fr.kind == "err":
+            raise GatewayError(str(fr.header.get("error", "")))
+        return fr
+
+    # ------------------------------------------------------------ submits
+    def submit_pairs(self, pairs, deadline_ms=None,
+                     timeout: float | None = None) -> int:
+        return self._submit(
+            lambda fid: protocol.encode_pairs(
+                fid, pairs, deadline_ms=deadline_ms,
+                epoch=self.epoch, diff_epoch=self.diff_epoch),
+            timeout=timeout)
+
+    def submit_rev(self, pairs, deadline_ms=None,
+                   timeout: float | None = None) -> int:
+        return self._submit(
+            lambda fid: protocol.encode_pairs(
+                fid, pairs, family="rev", deadline_ms=deadline_ms,
+                epoch=self.epoch, diff_epoch=self.diff_epoch),
+            timeout=timeout)
+
+    def submit_mat(self, s: int, targets, deadline_ms=None,
+                   timeout: float | None = None) -> int:
+        return self._submit(
+            lambda fid: protocol.encode_mat(
+                fid, s, targets, deadline_ms=deadline_ms,
+                epoch=self.epoch, diff_epoch=self.diff_epoch),
+            timeout=timeout)
+
+    def submit_alt(self, s: int, t: int, k: int, deadline_ms=None,
+                   timeout: float | None = None) -> int:
+        return self._submit(
+            lambda fid: protocol.encode_alt(
+                fid, s, t, k, deadline_ms=deadline_ms,
+                epoch=self.epoch, diff_epoch=self.diff_epoch),
+            timeout=timeout)
+
+    # --------------------------------------------------- sync conveniences
+    def query_batch(self, pairs, timeout: float | None = 30.0):
+        """``[(status, cost, plen, finished, cached), ...]`` in request
+        order — one frame, Q answers."""
+        fr = self.wait(self.submit_pairs(pairs, timeout=timeout),
+                       timeout=timeout)
+        return pair_rows(fr)
+
+    def query(self, s: int, t: int, timeout: float | None = 30.0):
+        return self.query_batch([(s, t)], timeout=timeout)[0]
+
+    def reverse_batch(self, pairs, timeout: float | None = 30.0):
+        fr = self.wait(self.submit_rev(pairs, timeout=timeout),
+                       timeout=timeout)
+        return pair_rows(fr)
+
+    def reverse(self, s: int, t: int, timeout: float | None = 30.0):
+        return self.reverse_batch([(s, t)], timeout=timeout)[0]
+
+    def matrix(self, s: int, targets, timeout: float | None = 30.0):
+        """The MAT row: ``[cost, ...]`` target-ordered, −1 per
+        unanswered target. A shed frame raises :class:`GatewayBusy`."""
+        fr = self.wait(self.submit_mat(s, targets, timeout=timeout),
+                       timeout=timeout)
+        _raise_shed(fr)
+        return [int(c) for c in fr.arrays[0]]
+
+    def alternatives(self, s: int, t: int, k: int,
+                     timeout: float | None = 30.0):
+        """``[(cost, via), ...]`` ascending, distinct first edges."""
+        fr = self.wait(self.submit_alt(s, t, k, timeout=timeout),
+                       timeout=timeout)
+        _raise_shed(fr)
+        return list(zip((int(c) for c in fr.arrays[0]),
+                        (int(v) for v in fr.arrays[1])))
+
+    def ping(self, timeout: float | None = 5.0) -> dict:
+        fid = self._submit(lambda fid: ({"kind": "ping", "id": fid},
+                                        []), timeout=timeout)
+        return dict(self.wait(fid, timeout=timeout).header)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._rthread.join(timeout=5.0)
+
+
+def pair_rows(fr):
+    statuses = fr.header.get("status") or []
+    cached = fr.header.get("cached") or []
+    cost, plen, fin = fr.arrays
+    return [(statuses[i] if i < len(statuses) else "ERROR",
+             int(cost[i]), int(plen[i]), bool(fin[i]),
+             bool(cached[i]) if i < len(cached) else False)
+            for i in range(len(cost))]
+
+
+def _raise_shed(fr):
+    status = fr.header.get("status")
+    if isinstance(status, str) and status != "OK":
+        raise GatewayBusy(f"{status}: {fr.header.get('detail', '')}")

@@ -1,0 +1,447 @@
+"""The gateway accept loop and the N-replica tier runner.
+
+One :class:`GatewayServer` is one stateless frontend replica facing
+clients: a unix-socket accept loop speaking the
+:mod:`.protocol` frame vocabulary over the shared
+:mod:`..transport.frames` container, in front of ONE
+:class:`~..serving.ServingFrontend` (admission, micro-batching,
+hedging, breakers, L1 cache — the whole existing head stack). Replicas
+share nothing but ``membership.json`` and the diff-epoch spool, both
+already safe for concurrent readers, so :class:`GatewayTier` scales the
+head horizontally by just running more of them.
+
+Connection protocol: the gateway sends a ``hello`` advertising its
+schema version, replica identity, and per-connection credit window.
+Query frames past the window answer an explicit ``busy``; malformed
+frames answer a typed ``err`` (never a torn connection) and book
+``gateway_frames_malformed_total``. Replies drain through one writer
+thread per connection in frame-arrival order — the frame ``id`` is the
+multiplexing correlate, in-order completion just keeps the writer
+trivially serial.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import queue
+import time
+
+from . import protocol
+from .config import GatewayConfig
+from ..obs import metrics as obs_metrics
+from ..obs import recorder as obs_recorder
+from ..transport.frames import (FrameReader, FrameWriter, TornFrame,
+                                TransportError)
+from ..utils.log import get_logger
+
+log = get_logger(__name__)
+
+M_REQS = obs_metrics.counter(
+    "gateway_requests_total",
+    "query frames admitted past the credit window")
+M_QUERIES = obs_metrics.counter(
+    "gateway_queries_total",
+    "individual queries across batched gateway frames")
+M_BUSY = obs_metrics.counter(
+    "gateway_busy_total",
+    "query frames answered BUSY at the credit window")
+M_MALFORMED = obs_metrics.counter(
+    "gateway_frames_malformed_total",
+    "client frames answered a typed err frame (malformed family, bad "
+    "payload, or newer schema) — never a torn connection")
+G_CLIENTS = obs_metrics.gauge(
+    "gateway_clients", "live client connections across local replicas")
+
+
+class GatewayServer:
+    """One replica's client-facing accept loop (see module docstring)."""
+
+    def __init__(self, frontend, families=None, fid: int = 0,
+                 gconf: GatewayConfig | None = None,
+                 socket_path: str | None = None):
+        self.frontend = frontend
+        self.families = families
+        self.fid = int(fid)
+        self.gconf = gconf or GatewayConfig.from_env()
+        self.socket_path = socket_path or self.gconf.socket_of(self.fid)
+        self._sock: socket.socket | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._accept_thread: threading.Thread | None = None
+        # plain tallies mutated under the GIL by the conn threads —
+        # approximate reads in statusz are fine
+        self.clients = 0
+        self.served = 0
+        self.busy = 0
+        self.malformed = 0
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "GatewayServer":
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(self.socket_path)
+        sock.listen(128)
+        sock.settimeout(0.25)
+        self._sock = sock
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"gateway-f{self.fid}-accept")
+        self._accept_thread.start()
+        obs_recorder.emit("gateway_up", frontend=self.fid,
+                          endpoint=self.socket_path,
+                          credit=self.gconf.credit)
+        log.info("gateway frontend %d serving on %s (credit %d)",
+                 self.fid, self.socket_path, self.gconf.credit)
+        return self
+
+    def stop(self, join_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=join_s)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        for th in list(self._threads):
+            th.join(timeout=join_s)
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+        obs_recorder.emit("gateway_down", frontend=self.fid,
+                          endpoint=self.socket_path, served=self.served)
+
+    # ------------------------------------------------------------- serve
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            th = threading.Thread(
+                target=self._conn_loop, args=(conn,), daemon=True,
+                name=f"gateway-f{self.fid}-conn")
+            th.start()
+            self._threads.append(th)
+            self._threads = [t for t in self._threads if t.is_alive()]
+
+    def _ident(self) -> dict:
+        fe = self.frontend
+        try:
+            epoch = int(fe._membership_epoch())
+        except Exception as e:  # noqa: BLE001 — identity is advisory
+            log.debug("gateway f%d: membership epoch unreadable: %s",
+                      self.fid, e)
+            epoch = 0
+        return {"frontend": self.fid, "epoch": epoch,
+                "diff_epoch": int(getattr(fe, "_diff_epoch", 0))}
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        conn.settimeout(None)
+        reader, writer = FrameReader(conn), FrameWriter(conn)
+        pending: queue.Queue = queue.Queue()
+        inflight = [0]   # mutated by reader, decremented by writer
+        wt = threading.Thread(
+            target=self._writer_loop, args=(writer, pending, inflight),
+            daemon=True, name=f"gateway-f{self.fid}-writer")
+        self.clients += 1
+        G_CLIENTS.add(1)
+        try:
+            writer.send(protocol.hello_header(
+                self.fid, self.gconf.credit,
+                **{k: v for k, v in self._ident().items()
+                   if k != "frontend"}))
+            wt.start()
+            while not self._stop.is_set():
+                try:
+                    fr = reader.read()
+                except TornFrame:
+                    break        # client died mid-frame; nothing to
+                    # answer — the typed-err contract covers frames
+                    # that ARRIVED malformed, not half-sent ones
+                if fr is None:
+                    break        # clean EOF
+                if not self._serve_frame(fr, pending, inflight):
+                    break
+        except (TransportError, OSError) as e:
+            log.debug("gateway f%d connection dropped: %s", self.fid, e)
+        finally:
+            pending.put(None)
+            if wt.is_alive():
+                wt.join(timeout=5.0)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self.clients -= 1
+            G_CLIENTS.add(-1)
+
+    def _writer_loop(self, writer: FrameWriter, pending: queue.Queue,
+                     inflight: list) -> None:
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            waiter, is_q = item
+            try:
+                header, arrays = waiter()
+            except Exception as e:  # noqa: BLE001 — one bad frame must
+                # not wedge the writer; answer it typed and move on
+                log.warning("gateway f%d reply build failed: %s",
+                            self.fid, e)
+                header, arrays = protocol.error_frame(
+                    -1, f"internal: {e}", **self._ident())
+            try:
+                writer.send(header, arrays)
+            except (TransportError, OSError):
+                return           # client is gone; reader will see EOF
+            finally:
+                if is_q:
+                    inflight[0] -= 1
+                    self.served += 1
+
+    def _serve_frame(self, fr, pending: queue.Queue,
+                     inflight: list) -> bool:
+        """Dispatch one client frame; False ends the connection (only
+        the schema gate does — malformed frames answer typed)."""
+        ident = self._ident()
+        if fr.kind == "hello":
+            try:
+                protocol.check_hello(fr.header)
+            except protocol.GatewaySchemaError as e:
+                M_MALFORMED.inc()
+                self.malformed += 1
+                detail = str(e)
+                fid = protocol.frame_id(fr)
+                pending.put((lambda: protocol.error_frame(
+                    fid, detail, **ident), False))
+                return False     # gate-newer: refuse service cleanly
+            return True
+        if fr.kind == "ping":
+            h = dict(ident)
+            h.update(kind="health", id=protocol.frame_id(fr),
+                     ok=True, clients=self.clients, served=self.served)
+            pending.put((lambda: (h, []), False))
+            return True
+        if fr.kind != "q":
+            # unknown kinds are the receiver's to skip (the container
+            # contract) — an older gateway ignores a newer client's
+            # optional extras rather than erroring them
+            log.debug("gateway f%d skipping unknown frame kind %r",
+                      self.fid, fr.kind)
+            return True
+        fid = protocol.frame_id(fr)
+        if inflight[0] >= self.gconf.credit:
+            M_BUSY.inc()
+            self.busy += 1
+            pending.put((lambda: protocol.busy_frame(fid, **ident),
+                         False))
+            return True
+        try:
+            family, payload = protocol.parse_query_frame(fr)
+        except protocol.GatewayProtocolError as e:
+            M_MALFORMED.inc()
+            self.malformed += 1
+            detail = str(e)
+            pending.put((lambda: protocol.error_frame(
+                fid, detail, **ident), False))
+            return True
+        M_REQS.inc()
+        inflight[0] += 1
+        deadline_s = self._deadline_s(fr.header)
+        pending.put((self._submit(fid, family, payload, deadline_s),
+                     True))
+        return True
+
+    def _deadline_s(self, header: dict) -> float:
+        dl = header.get("deadline_ms")
+        if isinstance(dl, (int, float)) and dl > 0:
+            return min(float(dl), self.gconf.deadline_ms) / 1e3
+        return self.gconf.deadline_s
+
+    # ------------------------------------------------------- family plumb
+    def _submit(self, fid: int, family: str, payload, deadline_s: float):
+        """Submit NOW (on the reader thread — admission and routing are
+        non-blocking), return the waiter the writer thread blocks on."""
+        ident = self._ident()
+        if family == "pair":
+            M_QUERIES.inc(len(payload))
+            futs = [self.frontend.submit(int(s), int(t))
+                    for s, t in payload]
+            pairs = [(int(s), int(t)) for s, t in payload]
+
+            def wait_pairs():
+                rows = _drain(futs, pairs, deadline_s)
+                return protocol.reply_pairs(fid, "pair", rows, **ident)
+
+            return wait_pairs
+        # the typed families ride QueryFamilies.submit_line so they
+        # inherit the brownout shed exactly like the line protocol
+        fam = self.families
+        if fam is None:
+            def no_families():
+                return protocol.reply_shed(
+                    fid, family, "ERROR", "family-not-served", **ident)
+            return no_families
+        if family == "rev":
+            M_QUERIES.inc(len(payload))
+            futs, pairs = [], []
+            for s, t in payload:
+                futs.append(fam.submit_line("rev", (int(s), int(t))))
+                pairs.append((int(s), int(t)))
+
+            def wait_rev():
+                rows = _drain_rev(futs, pairs, deadline_s)
+                return protocol.reply_pairs(fid, "rev", rows, **ident)
+
+            return wait_rev
+        if family == "mat":
+            s, targets = payload
+            M_QUERIES.inc(len(targets))
+            fut = fam.submit_line("mat", (int(s), [int(t)
+                                                   for t in targets]))
+
+            def wait_mat():
+                res = _family_result(fut, deadline_s)
+                if not hasattr(res, "costs"):   # shed/errored
+                    return protocol.reply_shed(
+                        fid, "mat", getattr(res, "status", "ERROR"),
+                        getattr(res, "detail", ""), **ident)
+                return protocol.reply_mat(fid, s, res.costs, **ident)
+
+            return wait_mat
+        # alt
+        s, t, k = payload
+        M_QUERIES.inc()
+        fut = fam.submit_line("alt", (int(s), int(t), int(k)))
+
+        def wait_alt():
+            res = _family_result(fut, deadline_s)
+            if not hasattr(res, "alternatives"):
+                return protocol.reply_shed(
+                    fid, "alt", getattr(res, "status", "ERROR"),
+                    getattr(res, "detail", ""), **ident)
+            return protocol.reply_alt(fid, s, t, res.alternatives,
+                                      **ident)
+
+        return wait_alt
+
+    # --------------------------------------------------------------- obs
+    def statusz(self) -> dict:
+        fe_cache = getattr(self.frontend, "cache", None)
+        out = {
+            "frontend": self.fid,
+            "endpoint": self.socket_path,
+            "credit": self.gconf.credit,
+            "clients": int(self.clients),
+            "served": int(self.served),
+            "busy": int(self.busy),
+            "malformed": int(self.malformed),
+        }
+        if fe_cache is not None:
+            out["l1_hits"] = int(fe_cache.hits)
+            out["l1_misses"] = int(fe_cache.misses)
+            out["l1_hit_rate"] = round(fe_cache.hit_rate(), 4)
+        return out
+
+
+def _drain(futs, pairs, deadline_s: float):
+    """In-order pair results with ONE deadline budgeted across the
+    frame (a stuck shard costs the frame one deadline, not one per
+    row) — TimeoutError rows degrade to typed TIMEOUT results."""
+    from ..serving.request import TIMEOUT, ServeResult
+
+    end = time.monotonic() + deadline_s
+    rows = []
+    for fut, (s, t) in zip(futs, pairs):
+        try:
+            rows.append(fut.result(max(0.0, end - time.monotonic())))
+        except TimeoutError:
+            rows.append(ServeResult(TIMEOUT, s, t,
+                                    detail="gateway-deadline"))
+    return rows
+
+
+def _drain_rev(futs, pairs, deadline_s: float):
+    """Rev rows: unwrap each CompositeFuture's ReverseResult back to
+    the underlying pair ServeResult (labeled with the ORIGINAL s, t the
+    client asked about, like the REV sentence)."""
+    from ..serving.request import TIMEOUT, ServeResult
+
+    end = time.monotonic() + deadline_s
+    rows = []
+    for fut, (s, t) in zip(futs, pairs):
+        try:
+            res = fut.result(max(0.0, end - time.monotonic()))
+        except TimeoutError:
+            rows.append(ServeResult(TIMEOUT, s, t,
+                                    detail="gateway-deadline"))
+            continue
+        inner = getattr(res, "result", res)   # ReverseResult | shed
+        rows.append(ServeResult(
+            inner.status, s, t, cost=int(inner.cost),
+            plen=int(inner.plen), finished=bool(inner.finished),
+            cached=bool(inner.cached), detail=inner.detail))
+    return rows
+
+
+def _family_result(fut, deadline_s: float):
+    from ..serving.request import TIMEOUT, ServeResult
+
+    try:
+        return fut.result(deadline_s)
+    except TimeoutError:
+        return ServeResult(TIMEOUT, -1, -1, detail="gateway-deadline")
+
+
+class GatewayTier:
+    """N replicas under one roof: builds a :class:`GatewayServer` per
+    ``(frontend, families)`` pair and aggregates their ``/statusz``
+    into the ``gateway`` section ``dos-obs top`` renders. Replicas are
+    independent — one replica's death leaves the others serving (the
+    kill-one-frontend drill pins this)."""
+
+    def __init__(self, replicas, gconf: GatewayConfig | None = None,
+                 socket_paths=None):
+        self.gconf = gconf or GatewayConfig.from_env()
+        self.servers: list[GatewayServer] = []
+        for fid, (frontend, families) in enumerate(replicas):
+            path = (socket_paths[fid] if socket_paths is not None
+                    else self.gconf.socket_of(fid))
+            self.servers.append(GatewayServer(
+                frontend, families=families, fid=fid, gconf=self.gconf,
+                socket_path=path))
+
+    @property
+    def endpoints(self) -> list:
+        return [srv.socket_path for srv in self.servers]
+
+    def start(self) -> "GatewayTier":
+        for srv in self.servers:
+            srv.start()
+        return self
+
+    def stop(self, join_s: float = 5.0) -> None:
+        for srv in self.servers:
+            srv.stop(join_s=join_s)
+
+    def statusz(self) -> dict:
+        fes = {str(srv.fid): srv.statusz() for srv in self.servers}
+        hits = sum(int(st.get("l1_hits", 0)) for st in fes.values())
+        misses = sum(int(st.get("l1_misses", 0)) for st in fes.values())
+        total = hits + misses
+        return {
+            "replicas": len(self.servers),
+            "clients": sum(int(st.get("clients", 0))
+                           for st in fes.values()),
+            "l1_hit_rate": round(hits / total, 4) if total else 0.0,
+            "frontends": fes,
+        }

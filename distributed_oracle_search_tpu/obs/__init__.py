@@ -284,6 +284,27 @@ README "Streaming data plane"):
   the primary attempt's already-written query file instead of paying
   a second filesystem round-trip per candidate).
 
+Gateway tier (``gateway/`` — N stateless frontends behind a binary
+client protocol, plus the shard-owner L2 result cache,
+``DOS_GATEWAY_*``; README "Gateway tier"):
+
+* client ingress — ``gateway_requests_total`` (frames received on
+  client connections: queries, hellos, pings),
+  ``gateway_queries_total`` (individual queries inside batched query
+  frames, all families), ``gateway_clients`` (gauge: live client
+  connections across this process's frontends);
+* backpressure — ``gateway_busy_total`` (query frames refused with an
+  explicit BUSY because the connection's credit window was full — the
+  gateway twin of ``rpc_busy_frames_total``);
+* protocol hygiene — ``gateway_frames_malformed_total`` (client
+  frames that failed to decode and were answered with a typed ERROR
+  frame instead of a torn connection);
+* shard-owner L2 cache — ``worker_l2_hits_total`` (queries answered
+  from the worker's ``(s, t, diff-epoch)`` cache before the kernel)
+  and ``worker_l2_misses_total`` (L2 lookups that fell through to the
+  kernel); entry counts and per-replica hit rates ride ``/statusz``,
+  not the registry.
+
 Compressed residency (``models.resident`` — RLE/pack4 CPD shards kept
 compressed in device memory and decompressed only at the point of use,
 ``DOS_CPD_RESIDENT``; README "Compressed residency"):

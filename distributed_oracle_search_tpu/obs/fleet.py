@@ -274,6 +274,35 @@ def _summarize(status: dict) -> dict:
                 and not isinstance(credit, bool):
             out["credit"] = int(credit)
         break
+    # gateway-tier columns: replica identity, client connections, and
+    # the two cache levels' hit rates. A gateway process ships a
+    # top-level "gateway" section (a tier reports its replica count, a
+    # single replica its frontend id), a worker ships "l2" under its
+    # worker section; pre-gateway fleets omit both and their rows show
+    # "-" blanks, never a crash
+    gw = status.get("gateway")
+    if isinstance(gw, dict) and gw:
+        reps = gw.get("replicas")
+        fe_id = gw.get("frontend")
+        if isinstance(reps, (int, float)) \
+                and not isinstance(reps, bool):
+            out["gw"] = f"x{int(reps)}"
+        elif isinstance(fe_id, (int, float)) \
+                and not isinstance(fe_id, bool):
+            out["gw"] = f"f{int(fe_id)}"
+        clients = gw.get("clients")
+        if isinstance(clients, (int, float)) \
+                and not isinstance(clients, bool):
+            out["clients"] = int(clients)
+        l1 = gw.get("l1_hit_rate")
+        if isinstance(l1, (int, float)) and not isinstance(l1, bool):
+            out["l1 hit"] = round(float(l1), 2)
+    l2 = worker.get("l2")
+    if isinstance(l2, dict):
+        rate = l2.get("hit_rate")
+        if isinstance(rate, (int, float)) \
+                and not isinstance(rate, bool):
+            out["l2 hit"] = round(float(rate), 2)
     # SLO / telemetry columns (the head's fleet-health plane): worst
     # fast-burn across objectives (the page-now signal) and worst
     # telemetry source lag (a stalled publisher or dead wire shows up
@@ -446,6 +475,19 @@ _KEY_DIRECTIONS = {
     "control_off_recover_seconds": "lower",
     "control_off_shed_rate": "lower",
     "control_off_p99_ms": "lower",
+    # the gateway family (N-replica tier vs the single head, PR 18):
+    # aggregate throughput, the tier-vs-head ratio, answer bit-identity
+    # (a 0/1 health bit), and both cache-plane hit rates improve UP;
+    # per-frontend fairness is a max/min q/s ratio whose ideal is 1.0,
+    # so it improves DOWN (no suffix catches it — listed like the
+    # other family contracts, in one place)
+    "gateway_aggregate_queries_per_sec": "higher",
+    "gateway_single_head_queries_per_sec": "higher",
+    "gateway_vs_single_head_ratio": "higher",
+    "gateway_fairness_ratio": "lower",
+    "gateway_answers_match": "higher",
+    "gateway_fleet_cache_hit_rate": "higher",
+    "gateway_single_head_cache_hit_rate": "higher",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -494,6 +536,21 @@ _KEY_TOLERANCES = {
     "control_off_shed_rate": 0.5,
     "control_p99_ms": 0.5,
     "control_off_p99_ms": 0.5,
+    # answer bit-identity between the gateway tier and the single-head
+    # line protocol is pass/fail: ANY drop (1 -> 0) gates
+    "gateway_answers_match": 0.0,
+    # hit rates on the fixed zipf pool are structural cache properties
+    # (keyspace skew / capacity), not timings — gate tighter than the
+    # throughput default
+    "gateway_fleet_cache_hit_rate": 0.2,
+    "gateway_single_head_cache_hit_rate": 0.2,
+    # tier throughput and fairness race thread scheduling on a shared
+    # host — gate loosely (a real regression, e.g. one replica starved
+    # to a halt, blows far past 2x)
+    "gateway_aggregate_queries_per_sec": 0.5,
+    "gateway_single_head_queries_per_sec": 0.5,
+    "gateway_vs_single_head_ratio": 0.5,
+    "gateway_fairness_ratio": 0.5,
 }
 
 

@@ -101,6 +101,13 @@ class ResultCache:
         self._od: OrderedDict[tuple, tuple] = OrderedDict()
         self._sigs: dict[tuple, frozenset] = {}
         self._bytes = 0
+        #: per-INSTANCE hit/miss tallies beside the process-global
+        #: counters: a gateway process hosts N replica L1s (and a test
+        #: process may host L1s and a worker L2 together), and the
+        #: per-replica hit rate in /statusz must not read a shared
+        #: registry counter that conflates them
+        self.hits = 0
+        self.misses = 0
         self._lock = OrderedLock("serving.ResultCache")
 
     @property
@@ -123,10 +130,36 @@ class ResultCache:
             entry = self._od.get(key)
             if entry is None:
                 M_MISSES.inc()
+                self.misses += 1
                 return None
             self._od.move_to_end(key)
             M_HITS.inc()
+            self.hits += 1
             return entry
+
+    def get_with_sig(self, key: tuple):
+        """``((cost, plen, finished), sig_or_None)`` or None; books
+        hit/miss. The shard-owner L2 path uses this: a sig-requesting
+        frontend needs the cached walk's node set back so the worker
+        can fabricate the paths payload a fresh kernel answer would
+        have carried."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            entry = self._od.get(key)
+            if entry is None:
+                M_MISSES.inc()
+                self.misses += 1
+                return None
+            self._od.move_to_end(key)
+            M_HITS.inc()
+            self.hits += 1
+            return entry, self._sigs.get(key)
+
+    def hit_rate(self) -> float:
+        """This instance's lifetime hit rate (0.0 before any lookup)."""
+        n = self.hits + self.misses
+        return self.hits / n if n else 0.0
 
     def put(self, key: tuple, value: tuple,
             sig: frozenset | None = None) -> None:

@@ -429,6 +429,11 @@ class RpcClient:
                 "connected": self._sock is not None,
                 "inflight": int(self._inflight),
                 "credit": int(self._window),
+                # the starvation signal the control daemon reads: how
+                # full this connection's credit window is (1.0 = every
+                # further call would block or shed BUSY)
+                "occupancy": (round(self._inflight / self._window, 4)
+                              if self._window > 0 else 0.0),
                 "connects": int(self._connects),
             }
 

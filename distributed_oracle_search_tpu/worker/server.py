@@ -104,6 +104,12 @@ M_RPC_MALFORMED = obs_metrics.counter(
     "rpc_server_frames_malformed_total",
     "request frames whose config was undecodable (answered FAIL, "
     "never a wedge) — the socket twin of server_frames_malformed_total")
+M_L2_HITS = obs_metrics.counter(
+    "worker_l2_hits_total",
+    "queries answered from the shard-owner L2 cache before the kernel")
+M_L2_MISSES = obs_metrics.counter(
+    "worker_l2_misses_total",
+    "L2 lookups that fell through to the kernel")
 
 
 class FifoServer:
@@ -129,6 +135,22 @@ class FifoServer:
             self.traffic = DiffEpochManager(traffic_dir,
                                             materialize=False)
             self.traffic.refresh()
+        #: shard-owner L2 result cache (gateway tier, ``DOS_GATEWAY_
+        #: L2_BYTES``): hot (s, t) entries answered BEFORE the kernel,
+        #: keyed like the frontend L1 (diff path + knob fingerprint +
+        #: both epochs) so fleet cache capacity scales with workers.
+        #: Default 0 keeps pre-gateway workers byte-identical.
+        from ..gateway.config import GatewayConfig
+        from ..serving.cache import ResultCache
+
+        self.l2 = ResultCache(GatewayConfig.from_env().l2_bytes)
+        if self.l2.enabled and self.traffic is not None:
+            # scoped invalidation LOCAL to the shard owning the updated
+            # edges: the gate-only epoch manager still computes each
+            # swap's affected-edge delta, so the L2 re-keys its
+            # provably-safe survivors exactly like the head's L1 did
+            self._l2_prev = self.traffic.active()[:2]
+            self.traffic.on_swap = self._l2_on_swap
         self.command_fifo = command_fifo or command_fifo_path(wid)
         self.graph = Graph.from_xy(conf.xy_file)
         self.dc = DistributionController(
@@ -314,9 +336,100 @@ class FifoServer:
             # an empty batch needs no engine: answer the empty row
             return (np.zeros(0, np.int64), np.zeros(0, np.int64),
                     np.zeros(0, bool), StatsRow(), None)
+        l2 = getattr(self, "l2", None)
+        if (l2 is not None and l2.enabled
+                and not (getattr(config, "extract", False)
+                         and getattr(config, "k_moves", 0) > 0)):
+            # extraction batches need the REAL per-move prefixes on the
+            # paths sidecar; everything else can short-circuit
+            return self._answer_l2(engine, queries, config, difffile)
         cost, plen, fin, stats = engine.answer(queries, config,
                                                difffile)
         return cost, plen, fin, stats, engine.last_paths
+
+    def _answer_l2(self, engine, queries: np.ndarray, config,
+                   difffile: str):
+        """The two-level cache plane's worker half: per-query L2
+        lookups before the kernel, the kernel only over the misses,
+        results merged back in query order. Keys mirror the frontend
+        L1 (diff path, knob fingerprint, membership epoch, diff epoch)
+        so an entry can never outlive the state that computed it; for
+        sig-requesting callers a hit fabricates its paths row from the
+        stored signature (sentinel ``moves=-1`` when it cannot — the
+        frontend then conservatively treats the entry sig-less)."""
+        from ..serving.cache import knob_fingerprint
+
+        l2 = self.l2
+        fp = knob_fingerprint(config)
+        epoch = int(getattr(self, "epoch", 0))
+        depoch = int(getattr(config, "diff_epoch", 0) or 0)
+        q = np.asarray(queries)
+        n = len(q)
+        keys = [(int(q[i, 0]), int(q[i, 1]), str(difffile), fp,
+                 epoch, depoch) for i in range(n)]
+        sig_k = int(getattr(config, "sig_k", 0) or 0)
+        width = sig_k + 1 if sig_k > 0 else 0
+        cost = np.zeros(n, np.int64)
+        plen = np.zeros(n, np.int64)
+        fin = np.zeros(n, bool)
+        nodes = np.zeros((n, width), np.int64) if width else None
+        moves = np.full(n, -1, np.int64) if width else None
+        miss_idx = []
+        for i, key in enumerate(keys):
+            hit = l2.get_with_sig(key)
+            if hit is None:
+                miss_idx.append(i)
+                continue
+            (c, p, f), sig = hit
+            cost[i], plen[i], fin[i] = int(c), int(p), bool(f)
+            if (width and sig is not None and 0 < len(sig) <= width
+                    and len(sig) - 1 == int(p)):
+                srt = sorted(sig)
+                nodes[i, :len(srt)] = srt
+                moves[i] = len(srt) - 1
+        M_L2_HITS.inc(n - len(miss_idx))
+        M_L2_MISSES.inc(len(miss_idx))
+        stats = StatsRow()
+        if miss_idx:
+            idx = np.asarray(miss_idx)
+            c2, p2, f2, stats = engine.answer(
+                np.ascontiguousarray(q[idx]), config, difffile)
+            cost[idx], plen[idx], fin[idx] = c2, p2, f2
+            lp = engine.last_paths
+            lp_ok = (width and lp is not None
+                     and lp[0].shape[1] == width)
+            if lp_ok:
+                nodes[idx] = lp[0]
+                moves[idx] = lp[1]
+            for j, i in enumerate(miss_idx):
+                sig = None
+                if lp_ok and int(lp[1][j]) == int(p2[j]):
+                    sig = frozenset(
+                        int(x) for x in lp[0][j, :int(lp[1][j]) + 1])
+                l2.put(keys[i],
+                       (int(c2[j]), int(p2[j]), bool(f2[j])), sig)
+        paths = (nodes, moves) if width else None
+        return cost, plen, fin, stats, paths
+
+    def _l2_on_swap(self, epoch: int, difffile: str,
+                    affected) -> None:
+        """Diff-epoch swap hook (gate-only epoch manager): scoped
+        invalidation of this shard's L2 — entries whose cached walk
+        provably avoids every updated edge re-key to the new fusion,
+        the rest drop. Runs on whichever thread refreshed the stream,
+        outside the manager's lock."""
+        old_diff, old_epoch = "", 0
+        prev = getattr(self, "_l2_prev", None)
+        if prev is not None:
+            old_epoch, old_diff = int(prev[0]), str(prev[1])
+        self._l2_prev = (epoch, difffile)
+        dropped, kept, reason = self.l2.invalidate_scoped(
+            affected, difffile, epoch,
+            max_edges=self.traffic.scoped_max,
+            old_diff=old_diff, old_depoch=old_epoch)
+        log.info("worker %d L2 swap epoch %d -> %d: %d dropped (%s), "
+                 "%d re-keyed", self.wid, old_epoch, epoch, dropped,
+                 reason, kept)
 
     def serve_forever(self) -> None:
         """Framed request loop over a PERSISTENT command-FIFO read session.
@@ -673,7 +786,16 @@ class FifoServer:
             return
         self._membership_state = state
         self.dc = membership.apply_state(self.dc, state)
+        old_epoch = getattr(self, "epoch", 0)
         self.epoch = state.epoch
+        l2 = getattr(self, "l2", None)
+        if l2 is not None and l2.enabled and state.epoch != old_epoch:
+            # old-epoch L2 keys are unreachable after a commit (the
+            # epoch is in the key) — flush so the budget serves the
+            # new assignment instead of pinning dead entries
+            n = l2.invalidate()
+            log.info("worker %d L2 flushed %d entries on epoch "
+                     "%d -> %d", self.wid, n, old_epoch, state.epoch)
         log.info("worker %d refreshed membership (epoch %d%s)",
                  self.wid, self.epoch,
                  ", migration window open"
@@ -744,6 +866,18 @@ class FifoServer:
         traffic = getattr(self, "traffic", None)
         if traffic is not None:
             out["diff_epoch"] = int(traffic.epoch)
+        # gateway cache plane: present only when the shard-owner L2 is
+        # enabled (pre-gateway fleets omit the key; `dos-obs top`
+        # renders blanks, never a crash)
+        l2 = getattr(self, "l2", None)
+        if l2 is not None and l2.enabled:
+            out["l2"] = {
+                "entries": len(l2),
+                "max_bytes": l2.max_bytes,
+                "hits": int(l2.hits),
+                "misses": int(l2.misses),
+                "hit_rate": round(l2.hit_rate(), 4),
+            }
         state = getattr(self, "_membership_state", None)
         if state is not None and state.migration is not None:
             out["migration"] = dict(state.migration)
