@@ -2511,6 +2511,227 @@ def main() -> None:
             f"single "
             f"{gateway_stats['gateway_single_head_cache_hit_rate']:.0%}")
 
+    # ---- gateway HA section: the partition chaos drill priced as a
+    # bench — one HA client (registry discovery) drives an open-loop
+    # burst over a 3-frontend leased tier while one frontend is killed
+    # abruptly and a second goes half-open (blackhole-conn). The
+    # contract under test: zero lost accepted requests, zero duplicate
+    # answers (resubmission dedup), and failover recovery bounded by
+    # the detection timeout + reconnect. BENCH_GATEWAY_HA=0 skips.
+    gateway_ha_stats = {}
+    if os.environ.get("BENCH_GATEWAY_HA", "1") != "0":
+        import queue as _hqueue
+        import socket as _hsocket  # noqa: F401 — parity with gw block
+        import threading as _hthreading
+
+        from distributed_oracle_search_tpu.data import (
+            ensure_synth_dataset, read_scen,
+        )
+        from distributed_oracle_search_tpu.data.graph import Graph
+        from distributed_oracle_search_tpu.gateway import (
+            DosClient, GatewayConfig, GatewayRegistry, GatewayTier,
+        )
+        from distributed_oracle_search_tpu.gateway import (
+            client as gateway_client,
+        )
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            RpcDispatcher, ServeConfig, ServingFrontend,
+        )
+        from distributed_oracle_search_tpu.testing import faults
+        from distributed_oracle_search_tpu.transport.frames import (
+            TransportError,
+        )
+        from distributed_oracle_search_tpu.transport.wire import (
+            RuntimeConfig,
+        )
+        from distributed_oracle_search_tpu.utils.config import (
+            ClusterConfig,
+        )
+        from distributed_oracle_search_tpu.worker import (
+            FifoServer, stop_server,
+        )
+        from distributed_oracle_search_tpu.worker.server import (
+            RpcServeLoop,
+        )
+
+        log("gateway HA (kill + blackhole mid-burst over a 3-frontend "
+            "leased tier, one failover client)...")
+        hdir = tempfile.mkdtemp(prefix="bench-gwha-")
+        _henv = {k: os.environ.get(k) for k in
+                 ("DOS_RPC_SOCKET_DIR", "DOS_FAULTS")}
+        os.environ["DOS_RPC_SOCKET_DIR"] = hdir
+        os.environ.pop("DOS_FAULTS", None)
+        hpaths = ensure_synth_dataset(hdir, width=16, height=12,
+                                      n_queries=256, seed=47)
+        hcfg = ClusterConfig(
+            workers=["localhost"], partmethod="mod", partkey=1,
+            outdir=os.path.join(hdir, "index"), xy_file=hpaths["xy"],
+            scenfile=hpaths["scen"], nfs=hdir).validate()
+        hg = Graph.from_xy(hcfg.xy_file)
+        hdc = DistributionController("mod", 1, 1, hg.n)
+        build_worker_shard(hg, hdc, 0, hcfg.outdir)
+        write_index_manifest(hcfg.outdir, hdc)
+        hqueries = read_scen(hcfg.scenfile)
+        hfifo = os.path.join(hdir, "ha-worker0.fifo")
+        hwsrv = FifoServer(hcfg, 0, command_fifo=hfifo)
+        hwth = _hthreading.Thread(target=hwsrv.serve_forever,
+                                  daemon=True)
+        hwth.start()
+        for _ in range(200):
+            if os.path.exists(hfifo):
+                break
+            time.sleep(0.02)
+        hloop = RpcServeLoop(hwsrv).start()
+        hrc = RuntimeConfig()
+        hn = int(os.environ.get("BENCH_GATEWAY_HA_REQUESTS", 2048))
+        hb = int(os.environ.get("BENCH_GATEWAY_HA_BATCH", 64))
+        hrng = np.random.default_rng(29)
+        hpool = hqueries[hrng.zipf(1.3, size=hn)
+                         .clip(1, len(hqueries)) - 1]
+        hwsrv.engine.answer(hqueries[:hb], hrc, "-")   # warm shapes
+
+        def _hfe():
+            fe = ServingFrontend(
+                hdc, RpcDispatcher(hcfg, timeout=120.0),
+                sconf=ServeConfig(queue_depth=max(hn, 1024),
+                                  max_batch=hb, max_wait_ms=2.0,
+                                  deadline_ms=600_000.0,
+                                  cache_bytes=0).validate())
+            fe.start()
+            return fe
+
+        hclient = None
+        htier = None
+        hfes = []
+        try:
+            hfes = [_hfe() for _ in range(3)]
+            hreg = GatewayRegistry(os.path.join(hdir, "reg"),
+                                   lease_s=1.0)
+            hgconf = GatewayConfig(
+                replicas=3, socket_dir=hdir, credit=64,
+                deadline_ms=600_000.0, lease_s=1.0).validate()
+            htier = GatewayTier([(fe, None) for fe in hfes],
+                                gconf=hgconf, registry=hreg).start()
+            # fault-free baseline over the SAME pool: the drill's
+            # answers must be bit-identical to these rows
+            hbase_client = DosClient(htier.endpoints[2])
+            hbase_rows = []
+            for i in range(0, hn, hb):
+                hbase_rows.extend(
+                    (st, cost, plen, fin) for st, cost, plen, fin,
+                    _c in hbase_client.query_batch(
+                        [(int(s), int(t)) for s, t in hpool[i:i + hb]],
+                        timeout=600.0))
+            hbase_client.close()
+
+            hclient = DosClient(registry_dir=hreg.dir)   # discovery
+            nbatches = (hn + hb - 1) // hb
+            kill_at, hole_at = nbatches // 3, (2 * nbatches) // 3
+            hfidq = _hqueue.Queue()
+
+            def _hpump():
+                try:
+                    for bi in range(nbatches):
+                        if bi == kill_at:
+                            # abrupt death: lease left to expire
+                            htier.servers[0].stop(graceful=False)
+                        if bi == hole_at:
+                            # half-open partition on whichever
+                            # frontend the client failed over to (f1,
+                            # next in discovery order)
+                            os.environ["DOS_FAULTS"] = \
+                                "blackhole-conn;wid=1;times=inf"
+                            faults.reset()
+                        batch = [
+                            (int(s), int(t))
+                            for s, t in hpool[bi * hb:(bi + 1) * hb]]
+                        hfidq.put((bi, hclient.submit_pairs(
+                            batch, timeout=600.0),
+                            time.perf_counter()))
+                finally:
+                    hfidq.put(None)
+
+            hrows_by_batch = {}
+            hlat_ms = []
+            hw = _hthreading.Thread(target=_hpump, daemon=True)
+            hw.start()
+            while True:
+                item = hfidq.get()
+                if item is None:
+                    break
+                bi, fid, t_sub = item
+                give_up = time.perf_counter() + 120.0
+                got = None
+                while got is None:
+                    try:
+                        got = gateway_client.pair_rows(
+                            hclient.wait(fid, timeout=2.0))
+                    except TimeoutError:
+                        # wait's own timeout already failed the
+                        # client over and resubmitted; re-wait
+                        # collects the replayed answer
+                        if time.perf_counter() > give_up:
+                            break
+                    except TransportError:
+                        break
+                if got is None:
+                    continue
+                hlat_ms.append((time.perf_counter() - t_sub) * 1e3)
+                hrows_by_batch[bi] = [(st, cost, plen, fin)
+                                      for st, cost, plen, fin, _c
+                                      in got]
+            hw.join()
+            # per-batch accounting so a dropped batch can't misalign
+            # the comparison: a never-answered request is lost; an
+            # answered-but-wrong request counts as lost too (the HA
+            # contract is bit-identical answers, tolerance 0)
+            hlost = 0
+            hmatch = 0
+            for bi in range(nbatches):
+                base = hbase_rows[bi * hb:(bi + 1) * hb]
+                rows = hrows_by_batch.get(bi)
+                if rows is None:
+                    hlost += len(base)
+                    continue
+                ok = sum(a == b for a, b in zip(base, rows))
+                hmatch += ok
+                hlost += len(base) - ok
+            hp99 = (float(np.percentile(np.asarray(hlat_ms), 99))
+                    if hlat_ms else float("nan"))
+            gateway_ha_stats = {
+                "gateway_ha_lost_requests": int(hlost),
+                "gateway_ha_duplicate_answers": int(hclient.unmatched),
+                "gateway_ha_failover_p99_ms": round(hp99, 1),
+            }
+            log(f"gateway HA: lost "
+                f"{gateway_ha_stats['gateway_ha_lost_requests']}, "
+                f"duplicates "
+                f"{gateway_ha_stats['gateway_ha_duplicate_answers']}, "
+                f"p99 {gateway_ha_stats['gateway_ha_failover_p99_ms']}"
+                f" ms across {hclient.failovers} failover(s), "
+                f"answers match {hmatch}/{hn}")
+        finally:
+            if hclient is not None:
+                hclient.close()
+            os.environ.pop("DOS_FAULTS", None)
+            faults.reset()
+            if htier is not None:
+                htier.stop()
+            for fe in hfes:
+                fe.stop()
+            stop_server(hfifo, deadline_s=5.0)
+            hwth.join(timeout=15)
+            hloop.stop()
+            shutil.rmtree(hdir, ignore_errors=True)
+            for k, v in _henv.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
     # ---- telemetry section: the fleet telemetry bus priced in
     # isolation — publish-side tick cost (what the bus adds to every
     # resident process each DOS_TELEMETRY_INTERVAL_S; the acceptance
@@ -3268,6 +3489,7 @@ def main() -> None:
         **serve_stats,
         **rpc_stats,
         **gateway_stats,
+        **gateway_ha_stats,
         **telemetry_stats,
         **repl_stats,
         **reshard_stats,

@@ -15,6 +15,16 @@ Clients use :class:`~..gateway.DosClient`; sockets land at
 ``DOS_GATEWAY_*`` env vars, overridable by flags. ``--obs-port`` serves
 ``/statusz`` with a ``gateway`` section (per-replica client counts and
 L1 hit rates) that ``dos-obs top`` renders as the tier's columns.
+
+High availability: ``--registry-dir`` (default: the conf's index
+directory) points at the leased endpoint registry ``gateway.json``
+(:mod:`..gateway.registry`) — every replica registers its socket there
+and renews on a heartbeat, so clients discover and fail over by
+reading the file. ``--join`` claims fresh frontend ids ABOVE whatever
+the registry has seen, letting a second ``dos-gateway --join`` process
+(same registry, same worker pool) widen the tier horizontally: one
+logical tier spanning processes, bit-identical answers from every
+replica.
 """
 
 from __future__ import annotations
@@ -57,6 +67,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--credit", type=int, default=None,
                    help="per-connection credit window "
                         "(DOS_GATEWAY_CREDIT)")
+    p.add_argument("--registry-dir", default=None,
+                   help="leased endpoint registry directory holding "
+                        "gateway.json (default: the conf's index "
+                        "directory)")
+    p.add_argument("--lease-s", type=float, default=None,
+                   help="endpoint lease TTL seconds "
+                        "(DOS_GATEWAY_LEASE_S)")
+    p.add_argument("--join", action="store_true",
+                   help="join an existing tier: claim fresh frontend "
+                        "ids from the registry instead of starting at "
+                        "f0 (replicas spanning processes)")
     p.add_argument("--queue-depth", type=int, default=None,
                    help="per-shard queue bound (DOS_SERVE_QUEUE_DEPTH)")
     p.add_argument("--max-batch", type=int, default=None,
@@ -102,7 +123,22 @@ def main(argv=None) -> int:
         conf = ClusterConfig.load(args.c)
     gconf = GatewayConfig.from_env(
         replicas=args.replicas, socket_dir=args.socket_dir,
-        credit=args.credit)
+        credit=args.credit, lease_s=args.lease_s)
+    # the leased endpoint registry lives beside membership.json unless
+    # pointed elsewhere; every replica leases its socket there so
+    # clients discover/fail over and the control loop sees death
+    from ..gateway import GatewayRegistry
+    reg_dir = args.registry_dir or getattr(conf, "outdir", None)
+    endpoint_registry = (GatewayRegistry(reg_dir, lease_s=gconf.lease_s)
+                         if reg_dir else None)
+    fid_base = 0
+    if args.join:
+        if endpoint_registry is None:
+            log.error("--join needs a registry directory (the conf has "
+                      "no index dir; pass --registry-dir)")
+            return 2
+        fid_base = endpoint_registry.claim(gconf.replicas,
+                                           endpoint_of=gconf.socket_of)
     # each replica is a full serving stack from the SAME builder
     # dos-serve uses — admission, micro-batcher, hedging, breakers,
     # membership refresh, live-traffic epoch pump — so gateway replicas
@@ -111,16 +147,17 @@ def main(argv=None) -> int:
     from . import serve as serve_cli
     replicas = []
     registries = []
-    for fid in range(gconf.replicas):
+    for i in range(gconf.replicas):
         frontend, registry, families = serve_cli.build_frontend(
             conf, args)
         frontend.start()
         replicas.append((frontend, families))
         if registry is not None:
             registries.append(registry)
-        log.info("frontend replica %d up (%s backend)", fid,
+        log.info("frontend replica %d up (%s backend)", fid_base + i,
                  args.backend)
-    tier = GatewayTier(replicas, gconf=gconf)
+    tier = GatewayTier(replicas, gconf=gconf,
+                       registry=endpoint_registry, fid_base=fid_base)
     stop_evt = threading.Event()
 
     def _on_signal(signum, frame):

@@ -30,7 +30,7 @@ class Actuators:
 
     def __init__(self, *, frontend=None, supervisor=None, registry=None,
                  breaker_key=None, membership=None, replicate_fn=None,
-                 warm_fns=()):
+                 warm_fns=(), gateway_respawn_fn=None):
         self.frontend = frontend
         self.supervisor = supervisor
         self.registry = registry
@@ -40,6 +40,7 @@ class Actuators:
         self.membership = membership
         self.replicate_fn = replicate_fn
         self.warm_fns = list(warm_fns)
+        self.gateway_respawn_fn = gateway_respawn_fn
         self._orig = None           # pristine (hedge_budget, deadline_ms)
         self._threads: list[threading.Thread] = []
         self._tlock = threading.Lock()
@@ -70,6 +71,21 @@ class Actuators:
         if not did:
             raise RuntimeError("no registry or supervisor to "
                                "quarantine with")
+
+    def kick_frontend(self, fid: int) -> None:
+        """Recover a gateway frontend whose endpoint lease expired:
+        the tier runner's ``gateway_respawn_fn`` (which respawns the
+        replica in place and re-registers it) when wired, else the
+        worker supervisor's kick (gateway-over-supervised-process
+        deployments), else a wiring error."""
+        if self.gateway_respawn_fn is not None:
+            self.gateway_respawn_fn(int(fid))
+            return
+        if self.supervisor is not None:
+            self.supervisor.kick(int(fid))
+            return
+        raise RuntimeError("no gateway_respawn_fn or supervisor to "
+                           "kick a dead gateway frontend with")
 
     def readmit(self, wid: int) -> None:
         if self.registry is not None:

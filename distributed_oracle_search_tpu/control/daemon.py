@@ -22,7 +22,7 @@ from ..utils.log import get_logger
 from .actuators import Actuators
 from .config import ControlConfig
 from .policy import (ActionBudget, BrownoutLadder, Cooldown,
-                     QuarantineManager, RepairScaler)
+                     GatewayWatch, QuarantineManager, RepairScaler)
 from .signals import SignalReader
 
 log = get_logger(__name__)
@@ -60,6 +60,10 @@ M_SCALE_ADVISED = obs_metrics.counter(
 M_WARMS = obs_metrics.counter(
     "control_warms_total",
     "predictive warm actions (next diff epoch pre-fused, warmers run)")
+M_GATEWAY_KICKS = obs_metrics.counter(
+    "control_gateway_kicks_total",
+    "dead gateway frontends kicked for respawn (expired endpoint "
+    "lease in gateway.json)")
 
 
 class ControlDaemon:
@@ -75,7 +79,8 @@ class ControlDaemon:
                  slo=None, frontend=None, supervisor=None,
                  registry=None, breaker_key=None, membership=None,
                  ingest=None, replicate_fn=None, warm_fns=(),
-                 probe_fn=None, clock=time.monotonic):
+                 probe_fn=None, gateway=None, gateway_respawn_fn=None,
+                 clock=time.monotonic):
         self.config = config or ControlConfig.from_env()
         self.clock = clock
         self.signals = SignalReader(
@@ -83,11 +88,12 @@ class ControlDaemon:
             supervisor=supervisor, registry=registry,
             breaker_key=breaker_key or (
                 getattr(frontend, "_breaker_key", None)),
-            clock=clock)
+            gateway=gateway, clock=clock)
         self.actuators = Actuators(
             frontend=frontend, supervisor=supervisor, registry=registry,
             breaker_key=breaker_key, membership=membership,
-            replicate_fn=replicate_fn, warm_fns=warm_fns)
+            replicate_fn=replicate_fn, warm_fns=warm_fns,
+            gateway_respawn_fn=gateway_respawn_fn)
         self.supervisor = supervisor
         self.probe_fn = probe_fn
         cfg = self.config
@@ -106,6 +112,7 @@ class ControlDaemon:
             starve_frac=cfg.starve_frac, hot_frac=cfg.hot_shard_frac,
             clear_frac=cfg.clear_frac, hold_ticks=cfg.hold_ticks,
             cooldown_s=cfg.cooldown_s, join_host=cfg.join_host)
+        self.gateway_watch = GatewayWatch(cooldown_s=cfg.cooldown_s)
         self.last_action = ""
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -152,6 +159,7 @@ class ControlDaemon:
         self._tick_quarantine(sig, now)
         self._tick_brownout(sig, now)
         self._tick_repair(sig, now)
+        self._tick_gateway(sig, now)
         self._tick_warm(now)
 
     def _tick_quarantine(self, sig, now: float) -> None:
@@ -244,6 +252,14 @@ class ControlDaemon:
                     "control_scale_advise", mode="advisory",
                     executed=False,
                     queue_frac=round(sig.queue_frac, 3))
+
+    def _tick_gateway(self, sig, now: float) -> None:
+        for decision in self.gateway_watch.decide(sig, now):
+            _, fid, why = decision
+            self._decide(
+                "gateway_kick", M_GATEWAY_KICKS,
+                lambda f=fid: self.actuators.kick_frontend(f),
+                now, fid=fid, why=why)
 
     def _tick_warm(self, now: float) -> None:
         # warming bypasses the action budget: it is a read-mostly local

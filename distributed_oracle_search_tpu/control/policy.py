@@ -326,3 +326,31 @@ class RepairScaler:
                 and sig.hot_shard is not None):
             out.append(("replicate", sig.hot_shard))
         return out
+
+
+class GatewayWatch:
+    """Gateway frontend liveness: turn expired endpoint leases into
+    kick decisions.
+
+    The lease TTL already encodes the detection hysteresis (a frontend
+    is only in ``sig.gateway_dead`` after a full TTL of silence), so
+    this arm needs no trip/clear edge — just a per-frontend cooldown so
+    one dead replica yields one kick per window, not one per tick, and
+    a respawn gets a full lease of grace to re-register before the
+    daemon considers it dead again."""
+
+    def __init__(self, *, cooldown_s: float = 30.0):
+        self._cooldown = Cooldown(cooldown_s)
+
+    def decide(self, sig, now: float) -> list[tuple]:
+        out = []
+        for fid in sig.gateway_dead:
+            key = f"gwkick:{int(fid)}"
+            if self._cooldown.ready(key, now):
+                self._cooldown.mark(key, now)
+                stale = sig.gateway_lease_stale_s.get(int(fid))
+                why = (f"endpoint lease stale {stale:.1f}s"
+                       if isinstance(stale, (int, float))
+                       else "endpoint lease expired")
+                out.append(("kick", int(fid), why))
+        return out

@@ -53,6 +53,14 @@ class ControlSignals:
     #: shard with the largest queue share, and that share (0.0 idle)
     hot_shard: int | None = None
     hot_frac: float = 0.0
+    #: live frontend count from the gateway endpoint registry (None:
+    #: no registry sensor wired)
+    gateway_live: int | None = None
+    #: per-frontend lease staleness {fid: seconds since last renewal}
+    gateway_lease_stale_s: dict = dataclasses.field(default_factory=dict)
+    #: frontends whose endpoint lease has EXPIRED — crashed or zombie
+    #: (a cleanly-drained frontend unregistered and appears nowhere)
+    gateway_dead: tuple = ()
 
     def known_workers(self) -> set:
         out = set(self.worker_running) | set(self.ping_failures)
@@ -69,13 +77,14 @@ class SignalReader:
 
     def __init__(self, *, ingest=None, slo=None, frontend=None,
                  supervisor=None, registry=None, breaker_key=None,
-                 clock=time.monotonic):
+                 gateway=None, clock=time.monotonic):
         self.ingest = ingest
         self.slo = slo
         self.frontend = frontend
         self.supervisor = supervisor
-        self.registry = registry
+        self.registry = registry      # the BREAKER registry
         self.breaker_key = breaker_key
+        self.gateway = gateway        # the gateway ENDPOINT registry
         self.clock = clock
 
     def read(self, now: float | None = None) -> ControlSignals:
@@ -85,6 +94,7 @@ class SignalReader:
         self._read_supervisor(sig)
         self._read_telemetry(sig)
         self._read_breakers(sig)
+        self._read_gateway(sig)
         return sig
 
     # ------------------------------------------------------- providers
@@ -186,3 +196,27 @@ class SignalReader:
                     sig.breakers_open.add(wid)
         except Exception as e:  # noqa: BLE001 — degrade, keep ticking
             log.debug("control sense: breaker read failed: %s", e)
+
+    def _read_gateway(self, sig: ControlSignals) -> None:
+        """Gateway endpoint leases: live frontend count, per-frontend
+        lease staleness, and the set whose lease EXPIRED (crash or
+        ``lease-freeze`` zombie) — the kick arm's evidence."""
+        if self.gateway is None:
+            return
+        try:
+            snap = self.gateway.snapshot()
+            live = snap.get("live") or []
+            dead = snap.get("dead") or []
+            sig.gateway_live = len(live)
+            for row in list(live) + list(dead):
+                if isinstance(row, dict) and "fid" in row:
+                    stale = row.get("stale_s")
+                    if isinstance(stale, (int, float)):
+                        sig.gateway_lease_stale_s[int(row["fid"])] = \
+                            float(stale)
+            sig.gateway_dead = tuple(sorted(
+                int(row["fid"]) for row in dead
+                if isinstance(row, dict) and "fid" in row))
+        except Exception as e:  # noqa: BLE001 — degrade, keep ticking
+            log.debug("control sense: gateway registry read failed: %s",
+                      e)

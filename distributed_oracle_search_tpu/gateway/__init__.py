@@ -10,6 +10,10 @@ Three pieces (ROADMAP item 2):
   replica's accept loop, and :class:`GatewayTier`, N of them sharing
   nothing but ``membership.json`` and the diff-epoch spool.
 * :mod:`.client` — :class:`DosClient`, the library callers link.
+* :mod:`.registry` — the leased endpoint registry (``gateway.json``):
+  durable tier membership with heartbeat-renewed TTL leases, so
+  replicas span processes, clients discover and fail over, and the
+  control loop sees death without a crash signal.
 
 The two-level cache plane rides alongside: each replica's
 :class:`~..serving.cache.ResultCache` is a small L1, and workers keep
@@ -23,10 +27,17 @@ from .client import DosClient, GatewayBusy, GatewayError
 from .config import GatewayConfig
 from .protocol import (GATEWAY_SCHEMA_VERSION, GatewayProtocolError,
                        GatewaySchemaError)
+from .registry import (GATEWAY_REGISTRY_VERSION, GatewayLease,
+                       GatewayRegistry, GatewayRegistrySchemaError,
+                       RegistryState, live_endpoints, load_registry,
+                       save_registry)
 from .server import GatewayServer, GatewayTier
 
 __all__ = [
     "DosClient", "GatewayBusy", "GatewayError", "GatewayConfig",
     "GATEWAY_SCHEMA_VERSION", "GatewayProtocolError",
     "GatewaySchemaError", "GatewayServer", "GatewayTier",
+    "GATEWAY_REGISTRY_VERSION", "GatewayLease", "GatewayRegistry",
+    "GatewayRegistrySchemaError", "RegistryState", "live_endpoints",
+    "load_registry", "save_registry",
 ]

@@ -34,6 +34,15 @@ class GatewayConfig:
       each WORKER keeps in front of its kernel; ``0`` (the default)
       disables it, preserving pre-gateway worker behavior exactly.
       Env: ``DOS_GATEWAY_L2_BYTES`` (read worker-side).
+    * ``l2_admit`` — L2 admission policy: ``all`` (the default — every
+      miss inserts, byte-identical pre-HA behavior) or ``second-hit``
+      (a doorkeeper admits a key only on its second miss, keeping
+      one-hit-wonder queries from churning the byte budget).
+      Env: ``DOS_GATEWAY_L2_ADMIT`` (read worker-side).
+    * ``lease_s`` — TTL of a frontend's endpoint lease in
+      ``gateway.json``; the heartbeat renews at a third of it, and a
+      lease older than it marks the frontend dead for discovery,
+      failover, and the control loop. Env: ``DOS_GATEWAY_LEASE_S``.
     """
 
     replicas: int = 2
@@ -41,6 +50,8 @@ class GatewayConfig:
     credit: int = 32
     deadline_ms: float = 10_000.0
     l2_bytes: int = 0
+    l2_admit: str = "all"
+    lease_s: float = 10.0
 
     @classmethod
     def from_env(cls, **overrides) -> "GatewayConfig":
@@ -55,6 +66,8 @@ class GatewayConfig:
             deadline_ms=env_cast("DOS_GATEWAY_DEADLINE_MS",
                                  cls.deadline_ms, float),
             l2_bytes=env_cast("DOS_GATEWAY_L2_BYTES", cls.l2_bytes, int),
+            l2_admit=env_str("DOS_GATEWAY_L2_ADMIT", cls.l2_admit),
+            lease_s=env_cast("DOS_GATEWAY_LEASE_S", cls.lease_s, float),
         )
         for field, value in list(vals.items()):
             try:
@@ -78,6 +91,10 @@ class GatewayConfig:
             raise ValueError("deadline_ms must be positive")
         if self.l2_bytes < 0:
             raise ValueError("l2_bytes must be >= 0")
+        if self.l2_admit not in ("all", "second-hit"):
+            raise ValueError("l2_admit must be 'all' or 'second-hit'")
+        if self.lease_s <= 0:
+            raise ValueError("lease_s must be positive")
         return self
 
     @property

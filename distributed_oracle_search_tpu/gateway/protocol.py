@@ -18,7 +18,17 @@ Frame vocabulary (header ``kind``):
   NEWER than its own build and serves anything older.
 * ``q`` — one multiplexed query frame: ``{"id": n, "family":
   "pair"|"mat"|"alt"|"rev", "deadline_ms": optional, "epoch":
-  optional, "diff_epoch": optional}``. ``pair``/``rev`` carry one
+  optional, "diff_epoch": optional, "cid": optional client identity
+  token, "resubmit": optional}``. ``cid`` + ``id`` together name one
+  logical request across connections and frontends: a failover client
+  resubmits an unanswered frame with its ORIGINAL id, ``resubmit``
+  stamped true, and a frontend that already answered ``(cid, id)``
+  replays its memoized reply instead of double-booking counters and
+  cache inserts — exactly-once *accounting* over at-least-once
+  *execution* (answers are deterministic, so a re-execution on a
+  different frontend is bit-identical). Both keys ride the
+  unknown-key contract: pre-HA gateways simply ignore them.
+  ``pair``/``rev`` carry one
   int64 ``[Q, 2]`` payload segment of (s, t) rows — a BATCH per
   frame, retiring per-line text parsing from the hot ingress path;
   ``mat`` carries ``s`` in the header and an int64 ``[K]`` targets
@@ -84,7 +94,7 @@ def hello_header(fid: int, credit: int, *, epoch: int = 0,
 
 # ------------------------------------------------------------- queries
 def _q_header(fid: int, family: str, deadline_ms=None, epoch=None,
-              diff_epoch=None) -> dict:
+              diff_epoch=None, cid=None, resubmit=None) -> dict:
     h = {"kind": "q", "id": int(fid), "family": family,
          "gv": GATEWAY_SCHEMA_VERSION}
     if deadline_ms is not None:
@@ -93,6 +103,10 @@ def _q_header(fid: int, family: str, deadline_ms=None, epoch=None,
         h["epoch"] = int(epoch)
     if diff_epoch is not None:
         h["diff_epoch"] = int(diff_epoch)
+    if cid is not None:
+        h["cid"] = str(cid)
+    if resubmit:
+        h["resubmit"] = True
     return h
 
 
@@ -172,6 +186,13 @@ def frame_id(fr: Frame) -> int:
     in-flight request')."""
     fid = fr.header.get("id", -1)
     return int(fid) if isinstance(fid, (int, float)) else -1
+
+
+def frame_cid(fr: Frame) -> str | None:
+    """The client identity token, or ``None`` when the frame carries
+    none (pre-HA clients) — dedup only engages for tokened frames."""
+    cid = fr.header.get("cid")
+    return cid if isinstance(cid, str) and cid else None
 
 
 # -------------------------------------------------------------- replies

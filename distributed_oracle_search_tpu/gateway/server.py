@@ -18,10 +18,24 @@ frames answer a typed ``err`` (never a torn connection) and book
 thread per connection in frame-arrival order — the frame ``id`` is the
 multiplexing correlate, in-order completion just keeps the writer
 trivially serial.
+
+High availability (PR 19): given a :class:`~.registry.GatewayRegistry`
+the server registers its endpoint on start, renews the lease on a
+heartbeat thread (a third of ``DOS_GATEWAY_LEASE_S``; the
+``lease-freeze`` fault point makes a zombie), and unregisters on a
+GRACEFUL stop only — an abrupt death leaves the lease to expire, which
+is the detection signal. Replies to ``cid``-tokened query frames are
+memoized per ``(cid, id)`` in a bounded ring: a failover client's
+resubmission of an already-answered frame replays the stored reply and
+books ``gateway_resubmits_deduped_total`` instead of double-booking
+requests/queries/caches (exactly-once accounting). The
+``blackhole-conn`` fault point turns one connection half-open —
+accepted, read, never answered — the asymmetric-partition drill.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import socket
 import threading
@@ -32,11 +46,18 @@ from . import protocol
 from .config import GatewayConfig
 from ..obs import metrics as obs_metrics
 from ..obs import recorder as obs_recorder
+from ..testing import faults
 from ..transport.frames import (FrameReader, FrameWriter, TornFrame,
                                 TransportError)
+from ..utils.locks import OrderedLock
 from ..utils.log import get_logger
 
 log = get_logger(__name__)
+
+#: bounded reply memo per frontend: (cid, id) -> reply. Sized for many
+#: full credit windows of history — a resubmission races the original
+#: by seconds, not hours, so recency is the right eviction
+DEDUP_MEMO_ENTRIES = 4096
 
 M_REQS = obs_metrics.counter(
     "gateway_requests_total",
@@ -53,6 +74,15 @@ M_MALFORMED = obs_metrics.counter(
     "payload, or newer schema) — never a torn connection")
 G_CLIENTS = obs_metrics.gauge(
     "gateway_clients", "live client connections across local replicas")
+M_DEDUP = obs_metrics.counter(
+    "gateway_resubmits_deduped_total",
+    "resubmitted query frames answered from the (cid, id) reply memo — "
+    "counters and cache inserts not double-booked (exactly-once "
+    "accounting over at-least-once execution)")
+M_FAILOVER_FRAMES = obs_metrics.counter(
+    "gateway_failover_frames_total",
+    "resubmitted query frames this frontend had NOT answered before — "
+    "a client failed over here mid-flight and the frame re-executed")
 
 
 class GatewayServer:
@@ -60,22 +90,33 @@ class GatewayServer:
 
     def __init__(self, frontend, families=None, fid: int = 0,
                  gconf: GatewayConfig | None = None,
-                 socket_path: str | None = None):
+                 socket_path: str | None = None, registry=None):
         self.frontend = frontend
         self.families = families
         self.fid = int(fid)
         self.gconf = gconf or GatewayConfig.from_env()
         self.socket_path = socket_path or self.gconf.socket_of(self.fid)
+        self.registry = registry
         self._sock: socket.socket | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
         self._accept_thread: threading.Thread | None = None
+        self._hb_thread: threading.Thread | None = None
+        self._lease_frozen = False
+        self._lease_renewed = 0.0
+        # reply memo for resubmission dedup: (cid, id) -> (header,
+        # arrays), bounded LRU-by-insertion
+        self._dedup: collections.OrderedDict = collections.OrderedDict()
+        self._dedup_lock = OrderedLock("gateway.GatewayServer.dedup")
         # plain tallies mutated under the GIL by the conn threads —
         # approximate reads in statusz are fine
         self.clients = 0
         self.served = 0
         self.busy = 0
         self.malformed = 0
+        self.failovers = 0
+        self.deduped = 0
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "GatewayServer":
@@ -90,6 +131,13 @@ class GatewayServer:
             target=self._accept_loop, daemon=True,
             name=f"gateway-f{self.fid}-accept")
         self._accept_thread.start()
+        if self.registry is not None:
+            self.registry.register(self.fid, self.socket_path)
+            self._lease_renewed = time.time()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name=f"gateway-f{self.fid}-lease")
+            self._hb_thread.start()
         obs_recorder.emit("gateway_up", frontend=self.fid,
                           endpoint=self.socket_path,
                           credit=self.gconf.credit)
@@ -97,16 +145,34 @@ class GatewayServer:
                  self.fid, self.socket_path, self.gconf.credit)
         return self
 
-    def stop(self, join_s: float = 5.0) -> None:
+    def stop(self, join_s: float = 5.0, graceful: bool = True) -> None:
+        """Drain and stop. ``graceful=False`` is the chaos drills'
+        process-death stand-in: the endpoint lease is NOT unregistered,
+        so readers watch it expire — exactly what a crashed frontend
+        looks like from outside."""
         self._stop.set()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=join_s)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=join_s)
+            self._hb_thread = None
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
+        # sever established connections so blocked conn readers wake:
+        # a crash (graceful=False) tears both directions — clients see
+        # the socket die mid-conversation, exactly like a dead process;
+        # a drain only shuts the READ side, so replies already queued
+        # still flush before each conn loop closes its socket
+        how = socket.SHUT_RD if graceful else socket.SHUT_RDWR
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(how)
+            except OSError:
+                pass
         for th in list(self._threads):
             th.join(timeout=join_s)
         if os.path.exists(self.socket_path):
@@ -114,8 +180,38 @@ class GatewayServer:
                 os.unlink(self.socket_path)
             except OSError:
                 pass
+        if graceful and self.registry is not None:
+            try:
+                self.registry.unregister(self.fid, self.socket_path)
+            except (OSError, ValueError) as e:
+                log.warning("gateway f%d unregister failed: %s",
+                            self.fid, e)
         obs_recorder.emit("gateway_down", frontend=self.fid,
-                          endpoint=self.socket_path, served=self.served)
+                          endpoint=self.socket_path, served=self.served,
+                          graceful=bool(graceful))
+
+    def _heartbeat_loop(self) -> None:
+        interval = max(0.05, float(self.registry.lease_s) / 3.0)
+        while not self._stop.wait(interval):
+            if self._lease_frozen:
+                continue
+            if faults.inject("lease-freeze", wid=self.fid) is not None:
+                # the zombie case: alive and serving, silent in the
+                # registry — sticky for the rest of this server's life
+                self._lease_frozen = True
+                log.warning("gateway f%d lease renewals frozen (fault)",
+                            self.fid)
+                continue
+            try:
+                if not self.registry.renew(self.fid, self.socket_path):
+                    # our row vanished (registry reset/sweep): reclaim
+                    self.registry.register(self.fid, self.socket_path)
+                self._lease_renewed = time.time()
+            except Exception as e:  # noqa: BLE001 — a wedged registry
+                # write must not kill serving; the lease just goes
+                # stale and the control loop's sensor notices
+                log.warning("gateway f%d lease renewal failed: %s",
+                            self.fid, e)
 
     # ------------------------------------------------------------- serve
     def _accept_loop(self) -> None:
@@ -131,7 +227,9 @@ class GatewayServer:
                 name=f"gateway-f{self.fid}-conn")
             th.start()
             self._threads.append(th)
+            self._conns.append(conn)
             self._threads = [t for t in self._threads if t.is_alive()]
+            self._conns = [c for c in self._conns if c.fileno() != -1]
 
     def _ident(self) -> dict:
         fe = self.frontend
@@ -149,6 +247,7 @@ class GatewayServer:
         reader, writer = FrameReader(conn), FrameWriter(conn)
         pending: queue.Queue = queue.Queue()
         inflight = [0]   # mutated by reader, decremented by writer
+        conn_state = {"blackholed": False}
         wt = threading.Thread(
             target=self._writer_loop, args=(writer, pending, inflight),
             daemon=True, name=f"gateway-f{self.fid}-writer")
@@ -169,7 +268,8 @@ class GatewayServer:
                     # that ARRIVED malformed, not half-sent ones
                 if fr is None:
                     break        # clean EOF
-                if not self._serve_frame(fr, pending, inflight):
+                if not self._serve_frame(fr, pending, inflight,
+                                         conn_state):
                     break
         except (TransportError, OSError) as e:
             log.debug("gateway f%d connection dropped: %s", self.fid, e)
@@ -190,7 +290,7 @@ class GatewayServer:
             item = pending.get()
             if item is None:
                 return
-            waiter, is_q = item
+            waiter, is_q, dedup_key = item
             try:
                 header, arrays = waiter()
             except Exception as e:  # noqa: BLE001 — one bad frame must
@@ -199,6 +299,11 @@ class GatewayServer:
                             self.fid, e)
                 header, arrays = protocol.error_frame(
                     -1, f"internal: {e}", **self._ident())
+            if dedup_key is not None and header.get("kind") == "r":
+                # memoize BEFORE the send: a client that dies mid-reply
+                # resubmits, and the replay must cover exactly the
+                # frames whose accounting already booked
+                self._dedup_put(dedup_key, (header, arrays))
             try:
                 writer.send(header, arrays)
             except (TransportError, OSError):
@@ -208,10 +313,29 @@ class GatewayServer:
                     inflight[0] -= 1
                     self.served += 1
 
-    def _serve_frame(self, fr, pending: queue.Queue,
-                     inflight: list) -> bool:
+    def _dedup_put(self, key, reply) -> None:
+        with self._dedup_lock:
+            self._dedup[key] = reply
+            self._dedup.move_to_end(key)
+            while len(self._dedup) > DEDUP_MEMO_ENTRIES:
+                self._dedup.popitem(last=False)
+
+    def _dedup_get(self, key):
+        with self._dedup_lock:
+            return self._dedup.get(key)
+
+    def _serve_frame(self, fr, pending: queue.Queue, inflight: list,
+                     conn_state: dict) -> bool:
         """Dispatch one client frame; False ends the connection (only
         the schema gate does — malformed frames answer typed)."""
+        if conn_state["blackholed"] or faults.inject(
+                "blackhole-conn", wid=self.fid) is not None:
+            # half-open partition: the socket stays accepted and
+            # readable (the client's sends succeed) but nothing is
+            # served or answered, sticky for the connection's life —
+            # the client only learns via its own deadline + failover
+            conn_state["blackholed"] = True
+            return True
         ident = self._ident()
         if fr.kind == "hello":
             try:
@@ -222,14 +346,14 @@ class GatewayServer:
                 detail = str(e)
                 fid = protocol.frame_id(fr)
                 pending.put((lambda: protocol.error_frame(
-                    fid, detail, **ident), False))
+                    fid, detail, **ident), False, None))
                 return False     # gate-newer: refuse service cleanly
             return True
         if fr.kind == "ping":
             h = dict(ident)
             h.update(kind="health", id=protocol.frame_id(fr),
                      ok=True, clients=self.clients, served=self.served)
-            pending.put((lambda: (h, []), False))
+            pending.put((lambda: (h, []), False, None))
             return True
         if fr.kind != "q":
             # unknown kinds are the receiver's to skip (the container
@@ -239,11 +363,30 @@ class GatewayServer:
                       self.fid, fr.kind)
             return True
         fid = protocol.frame_id(fr)
+        cid = protocol.frame_cid(fr)
+        dedup_key = (cid, fid) if cid is not None else None
+        if dedup_key is not None:
+            replay = self._dedup_get(dedup_key)
+            if replay is not None:
+                # already answered this logical request: replay the
+                # memoized reply — no request/query counters, no
+                # frontend submit, no cache inserts (exactly-once
+                # accounting; the client just never saw the answer)
+                M_DEDUP.inc()
+                self.deduped += 1
+                pending.put((lambda r=replay: r, False, None))
+                return True
+            if fr.header.get("resubmit"):
+                # a failover arrival this frontend never answered:
+                # executes normally (answers are deterministic), but
+                # book the failover so the tier's HA columns show it
+                M_FAILOVER_FRAMES.inc()
+                self.failovers += 1
         if inflight[0] >= self.gconf.credit:
             M_BUSY.inc()
             self.busy += 1
             pending.put((lambda: protocol.busy_frame(fid, **ident),
-                         False))
+                         False, None))
             return True
         try:
             family, payload = protocol.parse_query_frame(fr)
@@ -252,13 +395,13 @@ class GatewayServer:
             self.malformed += 1
             detail = str(e)
             pending.put((lambda: protocol.error_frame(
-                fid, detail, **ident), False))
+                fid, detail, **ident), False, None))
             return True
         M_REQS.inc()
         inflight[0] += 1
         deadline_s = self._deadline_s(fr.header)
         pending.put((self._submit(fid, family, payload, deadline_s),
-                     True))
+                     True, dedup_key))
         return True
 
     def _deadline_s(self, header: dict) -> float:
@@ -345,7 +488,16 @@ class GatewayServer:
             "served": int(self.served),
             "busy": int(self.busy),
             "malformed": int(self.malformed),
+            "failovers": int(self.failovers),
+            "resubmits_deduped": int(self.deduped),
         }
+        if self.registry is not None:
+            out["lease"] = {
+                "lease_s": float(self.registry.lease_s),
+                "age_s": round(max(0.0, time.time()
+                                   - self._lease_renewed), 3),
+                "frozen": bool(self._lease_frozen),
+            }
         if fe_cache is not None:
             out["l1_hits"] = int(fe_cache.hits)
             out["l1_misses"] = int(fe_cache.misses)
@@ -410,15 +562,17 @@ class GatewayTier:
     kill-one-frontend drill pins this)."""
 
     def __init__(self, replicas, gconf: GatewayConfig | None = None,
-                 socket_paths=None):
+                 socket_paths=None, registry=None, fid_base: int = 0):
         self.gconf = gconf or GatewayConfig.from_env()
+        self.registry = registry
         self.servers: list[GatewayServer] = []
-        for fid, (frontend, families) in enumerate(replicas):
-            path = (socket_paths[fid] if socket_paths is not None
+        for i, (frontend, families) in enumerate(replicas):
+            fid = int(fid_base) + i
+            path = (socket_paths[i] if socket_paths is not None
                     else self.gconf.socket_of(fid))
             self.servers.append(GatewayServer(
                 frontend, families=families, fid=fid, gconf=self.gconf,
-                socket_path=path))
+                socket_path=path, registry=registry))
 
     @property
     def endpoints(self) -> list:
@@ -438,10 +592,27 @@ class GatewayTier:
         hits = sum(int(st.get("l1_hits", 0)) for st in fes.values())
         misses = sum(int(st.get("l1_misses", 0)) for st in fes.values())
         total = hits + misses
-        return {
+        out = {
             "replicas": len(self.servers),
             "clients": sum(int(st.get("clients", 0))
                            for st in fes.values()),
             "l1_hit_rate": round(hits / total, 4) if total else 0.0,
+            "failovers": sum(int(st.get("failovers", 0))
+                             for st in fes.values()),
+            "resubmits_deduped": sum(
+                int(st.get("resubmits_deduped", 0))
+                for st in fes.values()),
             "frontends": fes,
         }
+        if self.registry is not None:
+            try:
+                # peers counts the whole fleet (every --join process),
+                # not just this process's replicas
+                out["peers"] = len(self.registry.live())
+            except Exception as e:  # noqa: BLE001 — status is advisory
+                log.debug("gateway tier: registry read failed: %s", e)
+            ages = [st["lease"]["age_s"] for st in fes.values()
+                    if isinstance(st.get("lease"), dict)]
+            if ages:
+                out["lease_age_s"] = max(ages)
+        return out

@@ -302,8 +302,23 @@ client protocol, plus the shard-owner L2 result cache,
 * shard-owner L2 cache — ``worker_l2_hits_total`` (queries answered
   from the worker's ``(s, t, diff-epoch)`` cache before the kernel)
   and ``worker_l2_misses_total`` (L2 lookups that fell through to the
-  kernel); entry counts and per-replica hit rates ride ``/statusz``,
-  not the registry.
+  kernel); ``gateway_l2_admit_denied_total`` (inserts withheld by the
+  second-hit admission doorkeeper,
+  ``DOS_GATEWAY_L2_ADMIT=second-hit``); entry counts and per-replica
+  hit rates ride ``/statusz``, not the registry;
+* high availability (leased endpoint registry + client failover,
+  README "Gateway HA") — ``gateway_lease_renewals_total`` (endpoint
+  lease heartbeats written to ``gateway.json``),
+  ``gateway_live_frontends`` (gauge: frontends with an unexpired
+  lease at the last registry read), ``gateway_client_failovers_total``
+  (client connection moves to another live frontend, unanswered
+  frames resubmitted under their original ids),
+  ``gateway_resubmits_deduped_total`` (resubmitted frames a frontend
+  had already answered, replayed from the ``(cid, id)`` memo — the
+  exactly-once accounting guarantee), and
+  ``gateway_failover_frames_total`` (resubmitted frames re-executed
+  on a frontend that had NOT answered them — the at-least-once
+  execution half; answers stay bit-identical).
 
 Compressed residency (``models.resident`` — RLE/pack4 CPD shards kept
 compressed in device memory and decompressed only at the point of use,
@@ -388,7 +403,10 @@ README "Closed-loop control"):
   (scale-up advisories booked where the daemon owns no actuator:
   no join host configured, or lane widening needing a worker restart);
 * warming — ``control_warms_total`` (next diff epoch pre-fused /
-  registered warmers run ahead of the pump cadence).
+  registered warmers run ahead of the pump cadence);
+* gateway HA arm — ``control_gateway_kicks_total`` (dead gateway
+  frontends kicked for respawn after their ``gateway.json`` endpoint
+  lease expired).
 """
 
 from . import device, fleet, metrics, quantiles, trace

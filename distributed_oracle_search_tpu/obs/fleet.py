@@ -297,6 +297,21 @@ def _summarize(status: dict) -> dict:
         l1 = gw.get("l1_hit_rate")
         if isinstance(l1, (int, float)) and not isinstance(l1, bool):
             out["l1 hit"] = round(float(l1), 2)
+        # HA columns (PR 19): fleet-wide live peer count from the
+        # endpoint registry, worst lease age across local replicas,
+        # and frames re-executed here after a client failover. Pre-HA
+        # gateways omit all three — blanks, never a crash
+        peers = gw.get("peers")
+        if isinstance(peers, (int, float)) \
+                and not isinstance(peers, bool):
+            out["peers"] = int(peers)
+        lease = gw.get("lease_age_s")
+        if isinstance(lease, (int, float)) \
+                and not isinstance(lease, bool):
+            out["lease s"] = round(float(lease), 1)
+        fo = gw.get("failovers")
+        if isinstance(fo, (int, float)) and not isinstance(fo, bool):
+            out["failover"] = int(fo)
     l2 = worker.get("l2")
     if isinstance(l2, dict):
         rate = l2.get("hit_rate")
@@ -488,6 +503,12 @@ _KEY_DIRECTIONS = {
     "gateway_answers_match": "higher",
     "gateway_fleet_cache_hit_rate": "higher",
     "gateway_single_head_cache_hit_rate": "higher",
+    # the gateway HA family (leased discovery + failover, PR 19): lost
+    # requests and duplicate answers are correctness counts whose ideal
+    # is 0, failover recovery time improves DOWN like any latency
+    "gateway_ha_lost_requests": "lower",
+    "gateway_ha_duplicate_answers": "lower",
+    "gateway_ha_failover_p99_ms": "lower",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -551,6 +572,15 @@ _KEY_TOLERANCES = {
     "gateway_single_head_queries_per_sec": 0.5,
     "gateway_vs_single_head_ratio": 0.5,
     "gateway_fairness_ratio": 0.5,
+    # HA drill correctness is absolute: losing ANY accepted request or
+    # double-booking ANY answer across a failover gates at zero
+    "gateway_ha_lost_requests": 0.0,
+    "gateway_ha_duplicate_answers": 0.0,
+    # failover latency is bounded by the lease TTL racing thread
+    # scheduling on a shared host — gate loosely (a real regression,
+    # e.g. failover stops working and waits burn their full deadline,
+    # blows far past 2x)
+    "gateway_ha_failover_p99_ms": 1.0,
 }
 
 

@@ -38,6 +38,18 @@ Points (enacted by the call sites, see the table in the README's
                      table may be current — the analog of a worker
                      whose membership state is wedged behind the
                      fleet, forcing the head's failover path.
+* ``blackhole-conn`` a gateway client connection goes half-open: from
+                     the fired frame on, the frontend keeps ACCEPTING
+                     (reading) the connection's frames but never
+                     replies — the client-visible signature of an
+                     asymmetric network partition, forcing the
+                     discovery/failover/resubmission path. ``wid``
+                     filters by frontend id.
+* ``lease-freeze``   a gateway frontend stays alive and serving but
+                     stops renewing its ``gateway.json`` endpoint
+                     lease (the zombie case): readers watch the lease
+                     expire while the process runs on. ``wid`` filters
+                     by frontend id; freezing is sticky once fired.
 
 Rule keys: ``wid`` restricts to one worker id, ``after`` skips the first
 N eligible events, ``times`` caps fires (``inf`` = always), ``delay`` and
@@ -69,7 +81,7 @@ KILL_EXIT_CODE = 86
 
 POINTS = ("drop-reply", "delay", "crash-engine", "corrupt-frame",
           "kill-mid-batch", "crash-build", "kill-during-reshard",
-          "stale-epoch-reply")
+          "stale-epoch-reply", "blackhole-conn", "lease-freeze")
 
 M_INJECTED = obs_metrics.counter(
     "faults_injected_total", "fault-harness rules fired (DOS_FAULTS)")

@@ -19,6 +19,7 @@ Extensions over the reference:
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import sys
@@ -110,6 +111,11 @@ M_L2_HITS = obs_metrics.counter(
 M_L2_MISSES = obs_metrics.counter(
     "worker_l2_misses_total",
     "L2 lookups that fell through to the kernel")
+M_L2_ADMIT_DENIED = obs_metrics.counter(
+    "gateway_l2_admit_denied_total",
+    "L2 inserts withheld by the second-hit admission doorkeeper "
+    "(DOS_GATEWAY_L2_ADMIT=second-hit): first-miss keys only mark the "
+    "ghost list, one-hit wonders never churn the byte budget")
 
 
 class FifoServer:
@@ -143,7 +149,16 @@ class FifoServer:
         from ..gateway.config import GatewayConfig
         from ..serving.cache import ResultCache
 
-        self.l2 = ResultCache(GatewayConfig.from_env().l2_bytes)
+        gconf = GatewayConfig.from_env()
+        self.l2 = ResultCache(gconf.l2_bytes)
+        #: L2 admission policy (``DOS_GATEWAY_L2_ADMIT``): ``all``
+        #: inserts every miss (byte-identical pre-HA behavior);
+        #: ``second-hit`` keeps a ghost list of once-missed keys and
+        #: admits only on the second miss, so one-hit-wonder queries
+        #: cannot churn the byte budget
+        self._l2_admit = gconf.l2_admit
+        self._l2_seen: collections.OrderedDict = collections.OrderedDict()
+        self._l2_seen_lock = OrderedLock("worker.FifoServer.l2_admit")
         if self.l2.enabled and self.traffic is not None:
             # scoped invalidation LOCAL to the shard owning the updated
             # edges: the gate-only epoch manager still computes each
@@ -406,10 +421,28 @@ class FifoServer:
                 if lp_ok and int(lp[1][j]) == int(p2[j]):
                     sig = frozenset(
                         int(x) for x in lp[0][j, :int(lp[1][j]) + 1])
-                l2.put(keys[i],
-                       (int(c2[j]), int(p2[j]), bool(f2[j])), sig)
+                if self._l2_admit_key(keys[i]):
+                    l2.put(keys[i],
+                           (int(c2[j]), int(p2[j]), bool(f2[j])), sig)
         paths = (nodes, moves) if width else None
         return cost, plen, fin, stats, paths
+
+    def _l2_admit_key(self, key) -> bool:
+        """Admission doorkeeper for one missed key. ``all`` admits
+        everything; ``second-hit`` admits only a key whose FIRST miss
+        already marked the ghost list (bounded FIFO of key hashes —
+        a ghost entry costs a set slot, not a cached value's bytes)."""
+        if self._l2_admit != "second-hit":
+            return True
+        cap = max(1024, int(self.l2.max_bytes) // 256)
+        with self._l2_seen_lock:
+            if self._l2_seen.pop(key, None) is not None:
+                return True
+            self._l2_seen[key] = True
+            while len(self._l2_seen) > cap:
+                self._l2_seen.popitem(last=False)
+        M_L2_ADMIT_DENIED.inc()
+        return False
 
     def _l2_on_swap(self, epoch: int, difffile: str,
                     affected) -> None:
@@ -877,6 +910,7 @@ class FifoServer:
                 "hits": int(l2.hits),
                 "misses": int(l2.misses),
                 "hit_rate": round(l2.hit_rate(), 4),
+                "admit": str(getattr(self, "_l2_admit", "all")),
             }
         state = getattr(self, "_membership_state", None)
         if state is not None and state.migration is not None:

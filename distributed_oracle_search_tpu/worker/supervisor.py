@@ -403,6 +403,8 @@ class WorkerSupervisor:
             # overwrite any already-scheduled backoff wait; 0.0 is the
             # "death not yet observed" sentinel so schedule explicitly
             w.next_spawn_at = time.monotonic()
+        obs_recorder.emit("supervisor_kick", wid=w.wid,
+                          respawn_scheduled=dead)
         return dead
 
     # --------------------------------------------------------- monitor
