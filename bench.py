@@ -3431,6 +3431,194 @@ def main() -> None:
         }
         shutil.rmtree(cdir, ignore_errors=True)
 
+    integrity_stats = {}
+    if os.environ.get("BENCH_INTEGRITY", "1") != "0":
+        from distributed_oracle_search_tpu.data import ensure_synth_dataset
+        from distributed_oracle_search_tpu.data.graph import Graph
+        from distributed_oracle_search_tpu.integrity.audit import (
+            AnswerAuditor, make_reference_fn,
+        )
+        from distributed_oracle_search_tpu.integrity.scrub import (
+            TableScrubber,
+        )
+        from distributed_oracle_search_tpu.models.cpd import (
+            build_worker_shard, write_index_manifest,
+        )
+        from distributed_oracle_search_tpu.parallel.partition import (
+            DistributionController,
+        )
+        from distributed_oracle_search_tpu.serving import (
+            EngineDispatcher, HedgeConfig, ServeConfig, ServingFrontend,
+        )
+        from distributed_oracle_search_tpu.testing import faults
+        from distributed_oracle_search_tpu.traffic import (
+            scenarios as iscen,
+        )
+        from distributed_oracle_search_tpu.transport.wire import (
+            RuntimeConfig,
+        )
+        from distributed_oracle_search_tpu.utils.config import (
+            ClusterConfig,
+        )
+
+        log("answer integrity (audit overhead at 0/1/10 per mille, "
+            "scrub overhead, corrupt-resident + corrupt-answer "
+            "drills)...")
+        igdir = tempfile.mkdtemp(prefix="bench-integrity-")
+        igpaths = ensure_synth_dataset(igdir, width=20, height=15,
+                                       n_queries=256, seed=53)
+        igconf = ClusterConfig(
+            workers=["localhost"] * 2, partmethod="mod", partkey=2,
+            outdir=os.path.join(igdir, "index"),
+            xy_file=igpaths["xy"], scenfile=igpaths["scen"],
+            nfs=igdir).validate()
+        ig_g = Graph.from_xy(igconf.xy_file)
+        ig_dc = DistributionController("mod", 2, 2, ig_g.n)
+        for wid in range(2):
+            build_worker_shard(ig_g, ig_dc, wid, igconf.outdir)
+        write_index_manifest(igconf.outdir, ig_dc)
+        ig_n = int(os.environ.get("BENCH_INTEGRITY_REQUESTS", 2000))
+        ig_pool = iscen.zipf_queries(ig_g.n, ig_n, seed=53)
+
+        def _integrity_run(audit_pm=0, scrub=False, answer_fp=False,
+                           pool=None):
+            """One timed serving burst; the cache is off so every
+            request pays a real dispatch (an audit/scrub overhead
+            hidden behind cache hits would be a meaningless number).
+            Returns (q/s, ok results, audit divergence count)."""
+            pool = ig_pool if pool is None else pool
+            igdisp = EngineDispatcher(igconf, graph=ig_g, dc=ig_dc)
+            igfe = ServingFrontend(
+                ig_dc, igdisp,
+                sconf=ServeConfig(max_batch=32, max_wait_ms=2.0,
+                                  queue_depth=max(ig_n, 2048),
+                                  deadline_ms=5000.0,
+                                  cache_bytes=0).validate(),
+                rconf=RuntimeConfig(answer_fp=answer_fp),
+                hconf=HedgeConfig(enabled=False))
+            auditor = scrubber = None
+            if audit_pm:
+                auditor = AnswerAuditor(
+                    igdisp, audit_pm,
+                    reference_fn=make_reference_fn(ig_g),
+                    queue_max=1024)
+                igfe.auditor = auditor
+            igfe.start()
+            try:
+                # warm outside the timed window: engines built,
+                # programs compiled
+                for f in [igfe.submit(int(s), int(t))
+                          for s, t in pool[:64]]:
+                    f.result(60)
+                if scrub:
+                    scrubber = TableScrubber(
+                        lambda: list(igdisp._engines.values()), 0.05)
+                    scrubber.start()
+                t0 = time.monotonic()
+                futs = [igfe.submit(int(s), int(t)) for s, t in pool]
+                res = [f.result(60) for f in futs]
+                wall = time.monotonic() - t0
+                divergence = 0
+                if auditor is not None:
+                    # drain the audit queue so divergences booked
+                    # off-path are all counted
+                    end = time.monotonic() + 60
+                    while (not auditor._q.empty()
+                           and time.monotonic() < end):
+                        time.sleep(0.02)
+                    divergence = sum(auditor.snapshot().values())
+            finally:
+                if scrubber is not None:
+                    scrubber.stop()
+                if auditor is not None:
+                    auditor.stop()
+                igfe.stop()
+            ok = [r for r in res if r.ok]
+            return len(ok) / wall, ok, divergence
+
+        base_qps, base_ok, _ = _integrity_run()
+        truth = {(r.s, r.t): (int(r.cost), int(r.plen))
+                 for r in base_ok}
+        audit1_qps, _, _ = _integrity_run(audit_pm=1)
+        audit10_qps, _, _ = _integrity_run(audit_pm=10)
+        scrub_qps, _, _ = _integrity_run(scrub=True)
+        # clean-run audit at full rate: every batch re-executed on the
+        # CPU reference lane — ANY divergence here is a real bug
+        _, _, clean_div = _integrity_run(audit_pm=1000,
+                                         pool=ig_pool[:400])
+
+        # corrupt-answer drill: bits flip in reply payloads after the
+        # fingerprint is computed; the dispatcher's verifier must
+        # suppress every one — served answers stay truth-identical
+        os.environ["DOS_FAULTS"] = "corrupt-answer;times=20"
+        faults.reset()
+        try:
+            _, drill_ok, _ = _integrity_run(answer_fp=True)
+        finally:
+            del os.environ["DOS_FAULTS"]
+            faults.reset()
+        wrong = sum(1 for r in drill_ok
+                    if (r.s, r.t) in truth
+                    and truth[(r.s, r.t)] != (int(r.cost),
+                                              int(r.plen)))
+
+        # corrupt-resident drill: flip rows in one engine's RESIDENT
+        # table behind serving's back; detection latency is flip ->
+        # the scrubber's corrupt-block booking (+ rebind from disk)
+        igdisp = EngineDispatcher(igconf, graph=ig_g, dc=ig_dc)
+        igfe = ServingFrontend(
+            ig_dc, igdisp,
+            sconf=ServeConfig(max_batch=32, max_wait_ms=2.0,
+                              queue_depth=2048, deadline_ms=5000.0,
+                              cache_bytes=0).validate(),
+            hconf=HedgeConfig(enabled=False))
+        igfe.start()
+        detect_s = float("nan")
+        try:
+            for f in [igfe.submit(int(s), int(t))
+                      for s, t in ig_pool[:64]]:
+                f.result(60)
+            ig_eng = next(iter(igdisp._engines.values()))
+            bad = np.array(np.asarray(ig_eng.fm), np.int8, copy=True)
+            bad[0, :] = np.where(bad[0, :] <= 0, 1, 0)
+            ig_eng.fm = bad
+            igscrub = TableScrubber(
+                lambda: list(igdisp._engines.values()), 0.05)
+            t_flip = time.monotonic()
+            igscrub.start()
+            try:
+                while time.monotonic() - t_flip < 30:
+                    if igscrub.corrupt_blocks > 0:
+                        detect_s = time.monotonic() - t_flip
+                        break
+                    time.sleep(0.01)
+            finally:
+                igscrub.stop()
+        finally:
+            igfe.stop()
+
+        integrity_stats = {
+            "integrity_base_queries_per_sec": round(base_qps, 1),
+            "integrity_audit1_queries_per_sec": round(audit1_qps, 1),
+            "integrity_audit10_queries_per_sec": round(audit10_qps, 1),
+            "integrity_scrub_queries_per_sec": round(scrub_qps, 1),
+            "integrity_audit_overhead_frac": round(
+                1.0 - audit1_qps / base_qps, 4),
+            "integrity_scrub_overhead_frac": round(
+                1.0 - scrub_qps / base_qps, 4),
+            "integrity_audit_divergence": int(clean_div),
+            "integrity_wrong_answers_served": int(wrong),
+            "integrity_detect_seconds": round(detect_s, 3),
+        }
+        log(f"  base {base_qps:,.0f} q/s; audit 1 per mille "
+            f"{audit1_qps:,.0f} q/s "
+            f"({integrity_stats['integrity_audit_overhead_frac']:+.1%}"
+            f" overhead); scrub on {scrub_qps:,.0f} q/s; clean-run "
+            f"divergences {clean_div}; corrupted answers served "
+            f"{wrong}; resident corruption detected in "
+            f"{detect_s:.2f}s")
+        shutil.rmtree(igdir, ignore_errors=True)
+
     target_time = 1.0  # north star: whole scenario < 1 s (BASELINE.json)
     detail = {
         "graph_nodes": g.n,
@@ -3495,6 +3683,7 @@ def main() -> None:
         **reshard_stats,
         **traffic_stats,
         **control_stats,
+        **integrity_stats,
         "devices": len(devices),
         "platform": devices[0].platform,
     }
@@ -3556,6 +3745,8 @@ def main() -> None:
         "traffic_scoped_hit_rate",
         "control_shed_rate", "control_off_shed_rate",
         "control_recover_seconds", "control_off_recover_seconds",
+        "integrity_audit_overhead_frac",
+        "integrity_wrong_answers_served", "integrity_detect_seconds",
         "devices", "platform",
     )
     headline = {k: detail[k] for k in headline_keys if k in detail}

@@ -187,6 +187,20 @@ def build_parser(prog: str | None = None) -> argparse.ArgumentParser:
                           "degraded (some blocks bad), 4 corrupt (no "
                           "usable manifest or no block survived), "
                           "mirroring process_query's exit codes.")
+    new.add_argument("--scrub", action="store_true",
+                     help="make_cpds: at-rest scrub cadence — repeat "
+                          "the --verify check-only pass every "
+                          "--scrub-interval seconds for --scrub-passes "
+                          "passes, exiting with the WORST pass code "
+                          "(0 clean / 3 degraded / 4 corrupt). The "
+                          "offline counterpart of the serve-side "
+                          "resident scrubber (DOS_SCRUB_INTERVAL_S).")
+    new.add_argument("--scrub-interval", type=float, default=60.0,
+                     help="--scrub: seconds between passes "
+                          "(default 60).")
+    new.add_argument("--scrub-passes", type=int, default=1,
+                     help="--scrub: number of passes; 0 repeats until "
+                          "interrupted (default 1).")
     new.add_argument("--engine", choices=["python", "native"],
                      default="python",
                      help="Host-mode worker engine: the JAX shard engine "

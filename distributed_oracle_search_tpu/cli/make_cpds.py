@@ -17,7 +17,10 @@ for each worker start the per-worker CPD build.
 ``-t`` runs the canned smoke config; ``-w N`` restricts to one worker
 (reference ``make_cpds.py:27-41,58-62``). ``--verify`` runs a
 check-only integrity pass over the conf's index instead of building
-(exit 0/3/4 clean/degraded/corrupt); ``--no-resume`` disables the
+(exit 0/3/4 clean/degraded/corrupt); ``--scrub`` repeats that pass on
+a cadence (``--scrub-interval``/``--scrub-passes``) and exits with the
+worst code seen — the at-rest counterpart of the serve-side resident
+scrubber; ``--no-resume`` disables the
 ledger-based crash-resume (on by default). ``--delta-from OLD --diff
 FUSED`` runs a DELTA rebuild: only rows the fused diff's changed edges
 can affect are recomputed, untouched blocks byte-copy, and the result
@@ -143,6 +146,31 @@ def run_verify(conf: ClusterConfig) -> int:
                       **({"fatal": report["fatal"]}
                          if report.get("fatal") else {})}))
     return code
+
+
+def run_scrub(conf: ClusterConfig, args) -> int:
+    """``--scrub``: repeat the ``--verify`` check-only pass on a
+    cadence and exit with the WORST code any pass produced (0 clean /
+    3 degraded / 4 corrupt — degradation seen once is degradation,
+    even if a later pass healed it out of view). ``--scrub-passes 0``
+    repeats until interrupted; the interrupt still reports honestly."""
+    import time
+
+    worst = passes = 0
+    budget = max(0, int(getattr(args, "scrub_passes", 1)))
+    try:
+        while True:
+            worst = max(worst, run_verify(conf))
+            passes += 1
+            log.info("scrub pass %d done (worst exit so far: %d)",
+                     passes, worst)
+            if budget and passes >= budget:
+                break
+            time.sleep(max(0.0, float(getattr(args, "scrub_interval",
+                                              60.0))))
+    except KeyboardInterrupt:
+        log.info("scrub interrupted after %d pass(es)", passes)
+    return worst
 
 
 def run_delta(conf: ClusterConfig, args) -> int:
@@ -295,6 +323,8 @@ def main(argv=None) -> int:
         ensure_synth_dataset(os.path.dirname(conf.xy_file) or "./data")
     else:
         conf = ClusterConfig.load(args.c)
+    if getattr(args, "scrub", False):
+        return run_scrub(conf, args)
     if getattr(args, "verify", False):
         return run_verify(conf)
     if getattr(args, "delta_from", None):

@@ -118,7 +118,12 @@ def build_frontend(conf: ClusterConfig, args):
         queue_depth=args.queue_depth, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, cache_bytes=args.cache_bytes,
         deadline_ms=args.deadline_ms)
-    rconf = RuntimeConfig()
+    # the answer-integrity plane (DOS_SCRUB_* / DOS_AUDIT_* /
+    # DOS_ANSWER_FP) — every default is off, in which case nothing is
+    # constructed and the wire stays byte-identical legacy
+    from ..integrity import IntegrityConfig
+    icfg = IntegrityConfig.from_env()
+    rconf = RuntimeConfig(answer_fp=icfg.answer_fp)
     diff = args.diff if args.diff is not None else (
         conf.diffs[0] if conf.diffs else "-")
     registry = None
@@ -213,7 +218,57 @@ def build_frontend(conf: ClusterConfig, args):
             frontend,
             graph_provider=lambda: Graph.from_xy(conf.xy_file),
             traffic=traffic)
+    _build_integrity(frontend, dispatcher, icfg, args.backend)
     return frontend, registry, families
+
+
+def _build_integrity(frontend, dispatcher, icfg, backend: str) -> None:
+    """Construct whatever slice of the integrity plane is enabled and
+    hang it off the frontend (``frontend.auditor`` /
+    ``frontend.scrubber`` — ``/statusz`` and the control daemon's
+    providers read them there). With every knob at its default this
+    constructs nothing."""
+    if not icfg.any_enabled:
+        return
+    if icfg.scrub_interval_s > 0:
+        if backend == "inproc":
+            from ..integrity.scrub import TableScrubber
+
+            # the dispatcher builds engines lazily on first dispatch;
+            # re-listing every pass picks up late arrivals
+            scrubber = TableScrubber(
+                lambda: list(dispatcher._engines.values()),
+                icfg.scrub_interval_s, icfg.scrub_blocks_per_pass)
+            scrubber.start()
+            frontend.scrubber = scrubber
+            log.info("resident scrubber on: every %.1fs, %s blocks/pass",
+                     icfg.scrub_interval_s,
+                     icfg.scrub_blocks_per_pass or "all")
+        else:
+            log.warning("DOS_SCRUB_INTERVAL_S ignored: the host "
+                        "backend's resident tables live in the worker "
+                        "processes, not here")
+    if icfg.audit_rate > 0:
+        from ..integrity.audit import AnswerAuditor, make_reference_fn
+
+        reference_fn = describe_fn = None
+        if backend == "inproc":
+            reference_fn = make_reference_fn(dispatcher.graph)
+
+            def describe_fn(wid, via):
+                eng = dispatcher._engines.get((int(wid), via))
+                return {"codec": getattr(eng, "resident_codec", None)
+                        } if eng is not None else {}
+        frontend.auditor = AnswerAuditor(
+            dispatcher, icfg.audit_rate, reference_fn=reference_fn,
+            describe_fn=describe_fn,
+            max_reference=icfg.audit_max_reference)
+        log.info("answer audit on: %d per mille, reference lane %s",
+                 icfg.audit_rate,
+                 "available" if reference_fn else "unavailable")
+    if icfg.answer_fp:
+        log.info("answer fingerprints on: replies and cache entries "
+                 "carry crc32 checks")
 
 
 def _mesh_mat_oracle(conf: ClusterConfig, dispatcher, traffic=None):
@@ -353,7 +408,9 @@ def main(argv=None) -> int:
         daemon = maybe_daemon(
             slo=slo_engine, frontend=frontend, registry=registry,
             membership=frontend.membership, ingest=tele_ingest,
-            probe_fn=probe_fn)
+            probe_fn=probe_fn, integrity=frontend.auditor,
+            scrub_fn=(frontend.scrubber.scrub_now
+                      if frontend.scrubber is not None else None))
         status_providers = {
             "serving": frontend.statusz,
             "device_programs": obs_device.snapshot,
@@ -362,6 +419,16 @@ def main(argv=None) -> int:
         }
         if daemon is not None:
             status_providers["control"] = daemon.statusz
+        if (frontend.auditor is not None
+                or frontend.scrubber is not None):
+            def _integrity_status(fe=frontend):
+                out = {}
+                if fe.auditor is not None:
+                    out["audit"] = fe.auditor.statusz()
+                if fe.scrubber is not None:
+                    out["scrub"] = fe.scrubber.statusz()
+                return out
+            status_providers["integrity"] = _integrity_status
         obs_srv = start_obs_server(
             args.obs_port,
             health_fn=lambda: {
@@ -389,6 +456,12 @@ def main(argv=None) -> int:
         if daemon is not None:
             daemon.stop()
         frontend.stop()
+        # integrity plane after the frontend: no new batches are being
+        # served, so the auditor drains its queue tail and exits
+        if frontend.auditor is not None:
+            frontend.auditor.stop()
+        if frontend.scrubber is not None:
+            frontend.scrubber.stop()
         if obs_srv is not None:
             obs_srv.close()
         # telemetry plane teardown: stop the loops, detach the global

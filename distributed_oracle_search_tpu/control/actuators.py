@@ -30,7 +30,7 @@ class Actuators:
 
     def __init__(self, *, frontend=None, supervisor=None, registry=None,
                  breaker_key=None, membership=None, replicate_fn=None,
-                 warm_fns=(), gateway_respawn_fn=None):
+                 warm_fns=(), gateway_respawn_fn=None, scrub_fn=None):
         self.frontend = frontend
         self.supervisor = supervisor
         self.registry = registry
@@ -41,6 +41,9 @@ class Actuators:
         self.replicate_fn = replicate_fn
         self.warm_fns = list(warm_fns)
         self.gateway_respawn_fn = gateway_respawn_fn
+        #: ``scrub_fn(shard)`` asks the resident-table scrubber for an
+        #: immediate pass over one shard (``TableScrubber.scrub_now``)
+        self.scrub_fn = scrub_fn
         self._orig = None           # pristine (hedge_budget, deadline_ms)
         self._threads: list[threading.Thread] = []
         self._tlock = threading.Lock()
@@ -71,6 +74,26 @@ class Actuators:
         if not did:
             raise RuntimeError("no registry or supervisor to "
                                "quarantine with")
+
+    def divergence_quarantine(self, wid: int, why: str) -> None:
+        """Pull a shard serving WRONG answers out of routing: force its
+        breaker open (wrong answers demand an immediate stop, not a
+        supervisor respawn — the process is healthy, its data is not)
+        and trigger a scrub-now of that shard so the resident-table
+        check runs before the probation loop's clean probes can earn
+        re-admission. The scrub half is best-effort: with no scrubber
+        wired the breaker pin alone still stops the bleeding."""
+        if self.registry is None:
+            raise RuntimeError("no breaker registry to quarantine a "
+                               "divergent shard with")
+        self.registry.force_open(self.breaker_key(wid), why=why)
+        if self.scrub_fn is not None:
+            try:
+                self.scrub_fn(int(wid))
+            except Exception as e:  # noqa: BLE001 — the breaker pin is
+                # the safety action; a scrub hiccup must not undo it
+                log.warning("control: scrub-now of shard %d failed: %s",
+                            wid, e)
 
     def kick_frontend(self, fid: int) -> None:
         """Recover a gateway frontend whose endpoint lease expired:

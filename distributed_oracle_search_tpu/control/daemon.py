@@ -22,7 +22,8 @@ from ..utils.log import get_logger
 from .actuators import Actuators
 from .config import ControlConfig
 from .policy import (ActionBudget, BrownoutLadder, Cooldown,
-                     GatewayWatch, QuarantineManager, RepairScaler)
+                     DivergenceWatch, GatewayWatch, QuarantineManager,
+                     RepairScaler)
 from .signals import SignalReader
 
 log = get_logger(__name__)
@@ -64,6 +65,10 @@ M_GATEWAY_KICKS = obs_metrics.counter(
     "control_gateway_kicks_total",
     "dead gateway frontends kicked for respawn (expired endpoint "
     "lease in gateway.json)")
+M_DIVERGENCE_Q = obs_metrics.counter(
+    "control_divergence_quarantines_total",
+    "shards pulled from routing on a confirmed audit divergence "
+    "(breaker force-open + scrub-now; re-admitted after clean probes)")
 
 
 class ControlDaemon:
@@ -80,7 +85,7 @@ class ControlDaemon:
                  registry=None, breaker_key=None, membership=None,
                  ingest=None, replicate_fn=None, warm_fns=(),
                  probe_fn=None, gateway=None, gateway_respawn_fn=None,
-                 clock=time.monotonic):
+                 integrity=None, scrub_fn=None, clock=time.monotonic):
         self.config = config or ControlConfig.from_env()
         self.clock = clock
         self.signals = SignalReader(
@@ -88,12 +93,12 @@ class ControlDaemon:
             supervisor=supervisor, registry=registry,
             breaker_key=breaker_key or (
                 getattr(frontend, "_breaker_key", None)),
-            gateway=gateway, clock=clock)
+            gateway=gateway, integrity=integrity, clock=clock)
         self.actuators = Actuators(
             frontend=frontend, supervisor=supervisor, registry=registry,
             breaker_key=breaker_key, membership=membership,
             replicate_fn=replicate_fn, warm_fns=warm_fns,
-            gateway_respawn_fn=gateway_respawn_fn)
+            gateway_respawn_fn=gateway_respawn_fn, scrub_fn=scrub_fn)
         self.supervisor = supervisor
         self.probe_fn = probe_fn
         cfg = self.config
@@ -113,6 +118,7 @@ class ControlDaemon:
             clear_frac=cfg.clear_frac, hold_ticks=cfg.hold_ticks,
             cooldown_s=cfg.cooldown_s, join_host=cfg.join_host)
         self.gateway_watch = GatewayWatch(cooldown_s=cfg.cooldown_s)
+        self.divergence_watch = DivergenceWatch(cooldown_s=cfg.cooldown_s)
         self.last_action = ""
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -156,11 +162,30 @@ class ControlDaemon:
         now = self.clock() if now is None else now
         M_TICKS.inc()
         sig = self.signals.read(now)
+        self._tick_divergence(sig, now)
         self._tick_quarantine(sig, now)
         self._tick_brownout(sig, now)
         self._tick_repair(sig, now)
         self._tick_gateway(sig, now)
         self._tick_warm(now)
+
+    def _tick_divergence(self, sig, now: float) -> None:
+        """DivergenceWatch runs BEFORE the health quarantine scan: a
+        shard pulled here enters the same QuarantineManager state, so
+        the probation loop below probes it this very tick and the
+        normal N-clean-probes re-admission applies. Re-admission is
+        gated on the scrub having had its say: ``divergence_quarantine``
+        triggered a scrub-now, and a corrupt resident table either
+        healed (clean probes follow) or keeps diverging (the next delta
+        re-quarantines after readmit_grace)."""
+        for decision in self.divergence_watch.decide(sig, now):
+            _, wid, why = decision
+            if self._decide(
+                    "divergence_quarantine", M_DIVERGENCE_Q,
+                    lambda w=wid, y=why:
+                    self.actuators.divergence_quarantine(w, y),
+                    now, wid=wid, why=why):
+                self.quarantine.quarantine_now(wid, now, why)
 
     def _tick_quarantine(self, sig, now: float) -> None:
         for decision in self.quarantine.decide(sig, now):

@@ -256,6 +256,21 @@ class QuarantineManager:
                                 f"unhealthy {now - ws.since:.0f}s"))
         return out
 
+    def quarantine_now(self, wid: int, now: float, why: str = "") -> None:
+        """Force a worker into quarantine from OUTSIDE the sick-signal
+        scan — the DivergenceWatch arm's entry point: an audit
+        divergence is direct evidence of wrong answers, not a health
+        inference, so it bypasses ``_sick_reason``. The worker then
+        earns re-admission through the SAME probation loop (N clean
+        probes) as every other quarantine."""
+        ws = self._get(wid)
+        if ws.state == Q_QUARANTINED:
+            return
+        ws.state = Q_QUARANTINED
+        ws.since = now
+        ws.clean = 0
+        ws.why = why
+
     def probe_result(self, wid: int, ok: bool) -> bool:
         """Book one probe outcome for a quarantined worker; True when
         the worker has earned re-admission (caller executes it and then
@@ -325,6 +340,41 @@ class RepairScaler:
         if (self._hot.observe(hot, now) == "trip"
                 and sig.hot_shard is not None):
             out.append(("replicate", sig.hot_shard))
+        return out
+
+
+class DivergenceWatch:
+    """Answer-audit divergences → quarantine decisions.
+
+    The auditor already verified the divergence on an independent lane,
+    so — like :class:`GatewayWatch` — this arm needs no trip/clear
+    hysteresis: ONE confirmed wrong answer is evidence enough. It acts
+    on DELTAS of the auditor's per-shard cumulative counts, with a
+    per-shard cooldown so a stream of divergences from one rotten shard
+    yields one quarantine per window. The high-water mark advances only
+    when the decision is actually emitted (cooldown-ready): a
+    divergence that arrives mid-cooldown is re-considered on the next
+    ready tick rather than silently forgotten."""
+
+    def __init__(self, *, cooldown_s: float = 30.0):
+        self._cooldown = Cooldown(cooldown_s)
+        self._seen: dict[int, int] = {}
+
+    def decide(self, sig, now: float) -> list[tuple]:
+        out = []
+        for wid, count in sorted(sig.audit_divergent.items()):
+            wid, count = int(wid), int(count)
+            fresh = count - self._seen.get(wid, 0)
+            if fresh <= 0:
+                continue
+            key = f"diverge:{wid}"
+            if not self._cooldown.ready(key, now):
+                continue
+            self._cooldown.mark(key, now)
+            self._seen[wid] = count
+            out.append(("divergence_quarantine", wid,
+                        f"{fresh} audit divergence(s) "
+                        f"({count} cumulative)"))
         return out
 
 

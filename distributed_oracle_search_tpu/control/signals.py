@@ -61,6 +61,10 @@ class ControlSignals:
     #: frontends whose endpoint lease has EXPIRED — crashed or zombie
     #: (a cleanly-drained frontend unregistered and appears nowhere)
     gateway_dead: tuple = ()
+    #: per-shard CUMULATIVE audit-divergence counts {wid: count} from
+    #: the answer auditor (integrity.audit) — the DivergenceWatch arm
+    #: acts on deltas, so cumulative totals survive a missed tick
+    audit_divergent: dict = dataclasses.field(default_factory=dict)
 
     def known_workers(self) -> set:
         out = set(self.worker_running) | set(self.ping_failures)
@@ -77,7 +81,7 @@ class SignalReader:
 
     def __init__(self, *, ingest=None, slo=None, frontend=None,
                  supervisor=None, registry=None, breaker_key=None,
-                 gateway=None, clock=time.monotonic):
+                 gateway=None, integrity=None, clock=time.monotonic):
         self.ingest = ingest
         self.slo = slo
         self.frontend = frontend
@@ -85,6 +89,7 @@ class SignalReader:
         self.registry = registry      # the BREAKER registry
         self.breaker_key = breaker_key
         self.gateway = gateway        # the gateway ENDPOINT registry
+        self.integrity = integrity    # the answer auditor (snapshot())
         self.clock = clock
 
     def read(self, now: float | None = None) -> ControlSignals:
@@ -95,6 +100,7 @@ class SignalReader:
         self._read_telemetry(sig)
         self._read_breakers(sig)
         self._read_gateway(sig)
+        self._read_integrity(sig)
         return sig
 
     # ------------------------------------------------------- providers
@@ -220,3 +226,18 @@ class SignalReader:
         except Exception as e:  # noqa: BLE001 — degrade, keep ticking
             log.debug("control sense: gateway registry read failed: %s",
                       e)
+
+    def _read_integrity(self, sig: ControlSignals) -> None:
+        """Answer-audit divergences: the auditor's per-shard cumulative
+        counts (``AnswerAuditor.snapshot``) — evidence a shard is
+        serving WRONG answers, the one failure mode no availability
+        sensor above can see."""
+        if self.integrity is None:
+            return
+        try:
+            snap = self.integrity.snapshot()
+            if isinstance(snap, dict):
+                sig.audit_divergent = {int(k): int(v)
+                                       for k, v in snap.items()}
+        except Exception as e:  # noqa: BLE001 — degrade, keep ticking
+            log.debug("control sense: integrity read failed: %s", e)

@@ -28,9 +28,14 @@ is the detection signal. Replies to ``cid``-tokened query frames are
 memoized per ``(cid, id)`` in a bounded ring: a failover client's
 resubmission of an already-answered frame replays the stored reply and
 books ``gateway_resubmits_deduped_total`` instead of double-booking
-requests/queries/caches (exactly-once accounting). The
-``blackhole-conn`` fault point turns one connection half-open —
-accepted, read, never answered — the asymmetric-partition drill.
+requests/queries/caches (exactly-once accounting). A CLEAN client
+disconnect (EOF after every reply flushed) proves the client saw its
+answers, so that connection's ``cid`` entries are purged from the memo
+— only crashed clients (torn frames, reset sockets) leave replay state
+behind, which keeps memo occupancy proportional to failures instead of
+total traffic. The ``blackhole-conn`` fault point turns one connection
+half-open — accepted, read, never answered — the asymmetric-partition
+drill.
 """
 
 from __future__ import annotations
@@ -247,7 +252,8 @@ class GatewayServer:
         reader, writer = FrameReader(conn), FrameWriter(conn)
         pending: queue.Queue = queue.Queue()
         inflight = [0]   # mutated by reader, decremented by writer
-        conn_state = {"blackholed": False}
+        conn_state = {"blackholed": False, "cids": set(),
+                      "clean_eof": False}
         wt = threading.Thread(
             target=self._writer_loop, args=(writer, pending, inflight),
             daemon=True, name=f"gateway-f{self.fid}-writer")
@@ -267,7 +273,12 @@ class GatewayServer:
                     # answer — the typed-err contract covers frames
                     # that ARRIVED malformed, not half-sent ones
                 if fr is None:
-                    break        # clean EOF
+                    # clean EOF: the client closed AFTER reading its
+                    # replies — its resubmission window is over, so its
+                    # memo entries are purged below (crash paths — torn
+                    # frames, reset sockets — keep theirs for failover)
+                    conn_state["clean_eof"] = True
+                    break
                 if not self._serve_frame(fr, pending, inflight,
                                          conn_state):
                     break
@@ -281,6 +292,10 @@ class GatewayServer:
                 conn.close()
             except OSError:
                 pass
+            if conn_state["clean_eof"]:
+                # after the writer joined, so replies memoized during
+                # the drain are purged too — nothing leaks back in
+                self._dedup_purge(conn_state["cids"])
             self.clients -= 1
             G_CLIENTS.add(-1)
 
@@ -324,6 +339,17 @@ class GatewayServer:
         with self._dedup_lock:
             return self._dedup.get(key)
 
+    def _dedup_purge(self, cids) -> None:
+        """Drop every memo entry belonging to ``cids`` (a cleanly
+        disconnected client cannot resubmit, so its replay state is
+        dead weight crowding the bounded ring)."""
+        if not cids:
+            return
+        with self._dedup_lock:
+            stale = [k for k in self._dedup if k[0] in cids]
+            for k in stale:
+                del self._dedup[k]
+
     def _serve_frame(self, fr, pending: queue.Queue, inflight: list,
                      conn_state: dict) -> bool:
         """Dispatch one client frame; False ends the connection (only
@@ -365,6 +391,8 @@ class GatewayServer:
         fid = protocol.frame_id(fr)
         cid = protocol.frame_cid(fr)
         dedup_key = (cid, fid) if cid is not None else None
+        if cid is not None:
+            conn_state["cids"].add(cid)
         if dedup_key is not None:
             replay = self._dedup_get(dedup_key)
             if replay is not None:
@@ -490,6 +518,8 @@ class GatewayServer:
             "malformed": int(self.malformed),
             "failovers": int(self.failovers),
             "resubmits_deduped": int(self.deduped),
+            "memo": {"entries": len(self._dedup),
+                     "cap": DEDUP_MEMO_ENTRIES},
         }
         if self.registry is not None:
             out["lease"] = {

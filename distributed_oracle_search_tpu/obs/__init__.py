@@ -407,6 +407,36 @@ README "Closed-loop control"):
 * gateway HA arm — ``control_gateway_kicks_total`` (dead gateway
   frontends kicked for respawn after their ``gateway.json`` endpoint
   lease expired).
+
+Answer-integrity plane (``integrity/`` — resident-table scrubbing,
+sampled dual-execution audit, and wire/cache answer fingerprints,
+``DOS_SCRUB_*`` / ``DOS_AUDIT_*`` / ``DOS_ANSWER_FP``; README "Answer
+integrity & auditing"):
+
+* resident scrubber — ``scrub_blocks_checked_total`` (resident blocks
+  crc32-compared against their digest-verified on-disk truth),
+  ``scrub_blocks_corrupt_total`` (blocks whose resident rows diverged
+  — silent in-memory corruption; the table re-binds from disk),
+  ``scrub_passes_total`` / ``scrub_pass_seconds`` (pass cadence and
+  wall cost — the overhead numerator the bench's integrity section
+  holds under its budget);
+* dual-execution audit — ``audit_batches_total`` (served batches
+  re-executed on an independent lane: replica, CPU reference, or
+  uncached recompute), ``audit_divergence_total`` (audits whose
+  re-execution DISAGREED with the served answer — the wrong-answer
+  alarm feeding the control loop's divergence-quarantine arm),
+  ``audit_dropped_total`` (samples dropped at the bounded queue — the
+  audit plane never backpressures serving), ``audit_lane_seconds``
+  (one re-execution + compare, by whichever lane ran);
+* answer fingerprints — ``answer_fp_mismatch_total`` (replies whose
+  crc32 answer fingerprint failed verification at a dispatcher or
+  results-sidecar decode; the batch fails over instead of serving
+  corrupted answers), ``cache_fingerprint_mismatch_total`` (cache
+  hits whose stored entry no longer matches its insertion-time
+  fingerprint — dropped and recomputed, never served);
+* control arm — ``control_divergence_quarantines_total`` (shards
+  pulled from routing on a confirmed audit divergence: breaker
+  force-open + scrub-now, re-admitted only after clean probes).
 """
 
 from . import device, fleet, metrics, quantiles, trace

@@ -509,6 +509,21 @@ _KEY_DIRECTIONS = {
     "gateway_ha_lost_requests": "lower",
     "gateway_ha_duplicate_answers": "lower",
     "gateway_ha_failover_p99_ms": "lower",
+    # the answer-integrity family (scrub + audit + fingerprints,
+    # PR 20): divergences on a clean run and corrupted answers served
+    # in the drill are correctness counts whose ideal is 0; the
+    # audit/scrub overhead fractions (1 - audited q/s / baseline q/s)
+    # and the corrupt-resident detection latency improve DOWN; the
+    # throughput columns improve UP like any q/s
+    "integrity_audit_divergence": "lower",
+    "integrity_wrong_answers_served": "lower",
+    "integrity_audit_overhead_frac": "lower",
+    "integrity_scrub_overhead_frac": "lower",
+    "integrity_detect_seconds": "lower",
+    "integrity_base_queries_per_sec": "higher",
+    "integrity_audit1_queries_per_sec": "higher",
+    "integrity_audit10_queries_per_sec": "higher",
+    "integrity_scrub_queries_per_sec": "higher",
 }
 
 #: per-key default tolerances (CLI --key-tolerance still overrides):
@@ -581,6 +596,22 @@ _KEY_TOLERANCES = {
     # e.g. failover stops working and waits burn their full deadline,
     # blows far past 2x)
     "gateway_ha_failover_p99_ms": 1.0,
+    # integrity correctness is absolute: an audit divergence on an
+    # uncorrupted run, or ANY corrupted answer reaching a client in
+    # the drill, gates at zero
+    "integrity_audit_divergence": 0.0,
+    "integrity_wrong_answers_served": 0.0,
+    # overhead fractions compare two q/s measurements racing host
+    # jitter (both near the noise floor at 1 per mille), and detection
+    # latency is a poll-cadence race — gate all three loosely; the
+    # raw q/s columns inherit the same story
+    "integrity_audit_overhead_frac": 1.0,
+    "integrity_scrub_overhead_frac": 1.0,
+    "integrity_detect_seconds": 0.5,
+    "integrity_base_queries_per_sec": 0.5,
+    "integrity_audit1_queries_per_sec": 0.5,
+    "integrity_audit10_queries_per_sec": 0.5,
+    "integrity_scrub_queries_per_sec": 0.5,
 }
 
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+from ..integrity.fingerprint import value_fingerprint
 from ..utils.locks import OrderedLock
 
 from ..obs import metrics as obs_metrics
@@ -73,6 +74,11 @@ M_REKEYED = obs_metrics.counter(
     "serve_cache_rekeyed_total",
     "scoped-invalidation survivors re-keyed to the new diff epoch "
     "(their path provably avoids every updated edge)")
+M_FP_BAD = obs_metrics.counter(
+    "cache_fingerprint_mismatch_total",
+    "cache hits whose stored crc32 answer fingerprint no longer "
+    "matched the entry (DOS_ANSWER_FP) — the entry is dropped and the "
+    "query recomputed, never served")
 
 
 def knob_fingerprint(config) -> tuple:
@@ -96,10 +102,17 @@ class ResultCache:
     KEY_DIFF = 2
     KEY_DEPOCH = 5
 
-    def __init__(self, max_bytes: int):
+    def __init__(self, max_bytes: int, fingerprint: bool = False):
         self.max_bytes = int(max_bytes)
+        #: DOS_ANSWER_FP: entries store a crc32 over their answer tuple
+        #: at put time and re-check it on EVERY hit — a rotted entry is
+        #: dropped (``cache_fingerprint_mismatch_total``) and the miss
+        #: path recomputes; a corrupt answer is never served from cache
+        self.fingerprint = bool(fingerprint)
         self._od: OrderedDict[tuple, tuple] = OrderedDict()
         self._sigs: dict[tuple, frozenset] = {}
+        self._fps: dict[tuple, int] = {}
+        self.fp_mismatches = 0
         self._bytes = 0
         #: per-INSTANCE hit/miss tallies beside the process-global
         #: counters: a gateway process hosts N replica L1s (and a test
@@ -122,13 +135,28 @@ class ResultCache:
         with self._lock:
             return len(self._od)
 
+    def _fp_ok_locked(self, key: tuple, entry: tuple) -> bool:
+        """Re-check the entry's stored fingerprint (no-op without one).
+        A mismatch drops the entry on the spot — the caller books a
+        miss and the query recomputes through the normal path."""
+        want = self._fps.get(key)
+        if want is None or value_fingerprint(entry) == want:
+            return True
+        M_FP_BAD.inc()
+        self.fp_mismatches += 1
+        del self._od[key]
+        self._fps.pop(key, None)
+        self._bytes -= self._cost(self._sigs.pop(key, None))
+        self._set_gauges_locked()
+        return False
+
     def get(self, key: tuple):
         """``(cost, plen, finished)`` or None; books hit/miss."""
         if not self.enabled:
             return None
         with self._lock:
             entry = self._od.get(key)
-            if entry is None:
+            if entry is None or not self._fp_ok_locked(key, entry):
                 M_MISSES.inc()
                 self.misses += 1
                 return None
@@ -147,7 +175,7 @@ class ResultCache:
             return None
         with self._lock:
             entry = self._od.get(key)
-            if entry is None:
+            if entry is None or not self._fp_ok_locked(key, entry):
                 M_MISSES.inc()
                 self.misses += 1
                 return None
@@ -181,6 +209,8 @@ class ResultCache:
                 if sig is not None:
                     self._sigs[key] = sig
                 self._bytes += self._cost(sig)
+            if self.fingerprint:
+                self._fps[key] = value_fingerprint(value)
             # evict on BOTH paths: a refresh that attaches a signature
             # to a previously signature-less entry grows the footprint
             # too — a stable hot pool re-answering with signatures
@@ -189,6 +219,7 @@ class ResultCache:
             while self._bytes > self.max_bytes and self._od:
                 old_key, _ = self._od.popitem(last=False)
                 self._bytes -= self._cost(self._sigs.pop(old_key, None))
+                self._fps.pop(old_key, None)
                 M_EVICT.inc()
             self._set_gauges_locked()
 
@@ -201,6 +232,7 @@ class ResultCache:
                 n = len(self._od)
                 self._od.clear()
                 self._sigs.clear()
+                self._fps.clear()
                 self._bytes = 0
             else:
                 doomed = [k for k in self._od
@@ -208,6 +240,7 @@ class ResultCache:
                 for k in doomed:
                     del self._od[k]
                     self._bytes -= self._cost(self._sigs.pop(k, None))
+                    self._fps.pop(k, None)
                 n = len(doomed)
             M_INV_FULL.inc(n)
             self._set_gauges_locked()
@@ -243,6 +276,7 @@ class ResultCache:
             if max_edges >= 0 and len(pairs) > max_edges:
                 self._od.clear()
                 self._sigs.clear()
+                self._fps.clear()
                 self._bytes = 0
                 M_INV_FULL.inc(n)
                 self._set_gauges_locked()
@@ -258,6 +292,7 @@ class ResultCache:
                 adj.setdefault(u, set()).add(v)
             new_od: OrderedDict[tuple, tuple] = OrderedDict()
             new_sigs: dict[tuple, frozenset] = {}
+            new_fps: dict[tuple, int] = {}
             dropped = 0
             new_bytes = 0
             for key, value in self._od.items():
@@ -278,9 +313,13 @@ class ResultCache:
                            + (int(new_depoch),))
                 new_od[new_key] = value
                 new_sigs[new_key] = sig
+                fp = self._fps.get(key)
+                if fp is not None:
+                    new_fps[new_key] = fp
                 new_bytes += self._cost(sig)
             self._od = new_od
             self._sigs = new_sigs
+            self._fps = new_fps
             self._bytes = new_bytes
             M_INV_SCOPED.inc(dropped)
             M_REKEYED.inc(len(new_od))

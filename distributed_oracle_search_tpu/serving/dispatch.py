@@ -41,8 +41,12 @@ import zlib
 
 import numpy as np
 
+from ..integrity.fingerprint import (
+    FingerprintError, M_FP_MISMATCH, answer_fingerprint,
+)
 from ..obs import metrics as obs_metrics
 from ..parallel.partition import DistributionController
+from ..testing import faults
 from ..transport import fifo as fifo_transport
 from ..transport import rpc as rpc_transport
 from ..transport.fifo import answer_fifo_path, command_fifo_path
@@ -76,6 +80,29 @@ class RpcUnavailableError(DispatchError):
     """The worker has no reachable RPC listener (connect refused /
     socket absent) — the ``auto`` transport's FIFO-fallback signal, as
     opposed to a worker that answered and failed."""
+
+
+def _fp_guard(wid: int, cost, plen, fin, rconf):
+    """In-process twin of the wire fingerprint check: fingerprint the
+    answers the engine just returned, run them past the
+    ``corrupt-answer`` fault point (the only way bytes can rot between
+    an in-process engine and its caller is injection), and re-verify.
+    A mismatch raises :class:`DispatchError` so the frontend's failover
+    machinery retries — a corrupted answer is never handed up. No-op
+    unless ``rconf.answer_fp``."""
+    if not getattr(rconf, "answer_fp", False):
+        return cost, plen, fin
+    fp = answer_fingerprint(cost, plen, fin)
+    if faults.inject("corrupt-answer", wid) is not None:
+        cost = np.array(cost, np.int64, copy=True)
+        if len(cost):
+            cost[0] ^= 1
+    if answer_fingerprint(cost, plen, fin) != fp:
+        M_FP_MISMATCH.inc()
+        raise DispatchError(
+            f"shard {wid}: answer fingerprint mismatch on the "
+            "in-process lane — corrupted answer suppressed")
+    return cost, plen, fin
 
 
 class EngineDispatcher:
@@ -186,7 +213,7 @@ class EngineDispatcher:
         eng, lane = self._lane(wid, via)
         with lane:
             cost, plen, fin, _stats = eng.answer(queries, rconf, diff)
-        return cost, plen, fin
+        return _fp_guard(wid, cost, plen, fin, rconf)
 
     def answer_batch_paths(self, wid: int, queries: np.ndarray,
                            rconf: RuntimeConfig, diff: str,
@@ -204,6 +231,7 @@ class EngineDispatcher:
         with lane:
             cost, plen, fin, _stats = eng.answer(queries, rconf, diff)
             nodes, moves = eng.last_paths or (None, None)
+        cost, plen, fin = _fp_guard(wid, cost, plen, fin, rconf)
         return cost, plen, fin, nodes, moves
 
 
@@ -413,6 +441,13 @@ class FifoDispatcher:
                 try:
                     cost, plen, fin = read_results_file(
                         results_file_for(qfile))
+                except FingerprintError as e:
+                    # the sidecar EXISTS but its answer bytes failed
+                    # the crc32 check — a data fault, not a version
+                    # skew; fail over without the legacy-server hint
+                    raise DispatchError(
+                        f"worker {via} on {host} returned a corrupted "
+                        f"results sidecar: {e}") from e
                 except (OSError, ValueError) as e:
                     # an old server (pre-`results` wire key) answers
                     # the stats line but writes no sidecar — a hard
@@ -557,6 +592,19 @@ class RpcDispatcher:
             raise DispatchError(
                 f"worker {via} rpc results length {len(cost)} != "
                 f"batch {len(queries)}")
+        fp_want = fr.header.get("fp")
+        if fp_want is not None:
+            # RuntimeConfig.answer_fp wire extension: the server
+            # fingerprinted the answer segments at birth; re-check
+            # after the socket hop before trusting them
+            got = answer_fingerprint(cost, plen, fin)
+            if got != int(fp_want):
+                M_FP_MISMATCH.inc()
+                raise DispatchError(
+                    f"worker {via} rpc reply failed the answer "
+                    f"fingerprint check (header {int(fp_want):08x}, "
+                    f"computed {got:08x}) — corrupted answer "
+                    "suppressed")
         if not want_paths:
             return cost, plen, fin
         nodes = moves = None

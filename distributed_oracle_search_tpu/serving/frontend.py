@@ -131,7 +131,17 @@ class ServingFrontend:
         self.registry = registry
         self._breaker_key = breaker_key or (lambda wid: wid)
         self._fp = knob_fingerprint(self.rconf)
-        self.cache = ResultCache(self.sconf.cache_bytes)
+        #: DOS_ANSWER_FP rides the rconf: when set, the dispatcher
+        #: verifies reply fingerprints AND the cache re-checks stored
+        #: entry fingerprints on every hit (integrity plane)
+        self.cache = ResultCache(
+            self.sconf.cache_bytes,
+            fingerprint=getattr(self.rconf, "answer_fp", False))
+        #: answer-integrity hooks (``integrity`` package), attached by
+        #: the serve CLI when the DOS_AUDIT_*/DOS_SCRUB_* knobs enable
+        #: them; None = byte-identical legacy behavior
+        self.auditor = None
+        self.scrubber = None
         #: hedged dispatch (replicated shards only): per-shard latency
         #: quantiles drive the duplicate-request delay, a rate budget
         #: bounds the duplicates
@@ -343,6 +353,14 @@ class ServingFrontend:
             # only under an active brownout — the legacy statusz body
             # stays byte-identical when the control plane is off
             out["shed_families"] = sorted(self.shed_families)
+        # integrity plane — sections appear only when a knob enabled
+        # them (legacy statusz body unchanged otherwise)
+        if self.cache.fingerprint:
+            out["cache"]["fp_mismatches"] = self.cache.fp_mismatches
+        if self.auditor is not None:
+            out["audit"] = self.auditor.statusz()
+        if self.scrubber is not None:
+            out["scrub"] = self.scrubber.statusz()
         # worker mesh shape (DOS_MESH_DEVICES resolution) — reported
         # best-effort: a head whose backend cannot resolve devices
         # (host-wire frontend with no local accelerator runtime) shows
@@ -561,6 +579,12 @@ class ServingFrontend:
                 M_ERRORS.inc()
                 self._finish(r, ServeResult(ERROR, r.s, r.t, detail=err))
             return
+        if self.auditor is not None:
+            # OFF the reply path: the clients' answers complete below
+            # regardless; the sampled dual execution decides whether to
+            # keep trusting this engine (integrity.audit)
+            self.auditor.maybe_submit(wid, via, candidates, queries,
+                                      self.rconf, diff, cost, plen, fin)
         for i, r in enumerate(live):
             val = (int(cost[i]), int(plen[i]), bool(fin[i]))
             if (r.key[2] == diff

@@ -50,6 +50,16 @@ Points (enacted by the call sites, see the table in the README's
                      lease (the zombie case): readers watch the lease
                      expire while the process runs on. ``wid`` filters
                      by frontend id; freezing is sticky once fired.
+* ``corrupt-resident``  bits flip in a loaded shard's RESIDENT rows
+                     after the disk digests verified clean — the
+                     in-memory corruption no manifest check can see
+                     and the resident-table scrubber's target. ``wid``
+                     filters by shard.
+* ``corrupt-answer`` bits flip in a reply's answer payload after the
+                     answer fingerprint was computed — wire/cache
+                     corruption the fingerprint verifier must catch
+                     before the value reaches a client. ``wid``
+                     filters by shard.
 
 Rule keys: ``wid`` restricts to one worker id, ``after`` skips the first
 N eligible events, ``times`` caps fires (``inf`` = always), ``delay`` and
@@ -81,7 +91,8 @@ KILL_EXIT_CODE = 86
 
 POINTS = ("drop-reply", "delay", "crash-engine", "corrupt-frame",
           "kill-mid-batch", "crash-build", "kill-during-reshard",
-          "stale-epoch-reply", "blackhole-conn", "lease-freeze")
+          "stale-epoch-reply", "blackhole-conn", "lease-freeze",
+          "corrupt-resident", "corrupt-answer")
 
 M_INJECTED = obs_metrics.counter(
     "faults_injected_total", "fault-harness rules fired (DOS_FAULTS)")

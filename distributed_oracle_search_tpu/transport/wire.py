@@ -132,6 +132,18 @@ class RuntimeConfig:
     contract: old servers filter the unknown key and simply ship no
     sidecar, and the cache degrades to conservative (signature-less)
     invalidation.
+
+    ``answer_fp`` is the answer-integrity wire extension
+    (``integrity``): the server fingerprints the reply's answer
+    segments (crc32, :mod:`integrity.fingerprint`) right after the
+    engine returns and ships the checksum with the answers — an extra
+    ``fp=<hex>`` token on the results-file header line (old readers
+    take ``int(header[0])`` and tolerate extra tokens) or an ``fp``
+    key on the RPC reply header. The dispatcher re-checks before
+    trusting the payload; a mismatch is a dispatch error (failover),
+    never a served answer. Same compat contract: old servers filter
+    the unknown key and ship no fingerprint, and verification simply
+    does not happen for that hop.
     """
 
     hscale: float = 1.0
@@ -150,6 +162,7 @@ class RuntimeConfig:
     epoch: int = 0
     diff_epoch: int = 0
     sig_k: int = 0
+    answer_fp: bool = False
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -329,21 +342,37 @@ def results_file_for(queryfile: str) -> str:
 
 
 def write_results_file(path: str, cost: np.ndarray, plen: np.ndarray,
-                       finished: np.ndarray) -> None:
+                       finished: np.ndarray,
+                       fp: int | None = None) -> None:
     """``Q`` header, then one ``cost plen finished`` row per query, in
-    the query file's order."""
+    the query file's order.
+
+    ``fp`` (the ``RuntimeConfig.answer_fp`` extension) rides the header
+    line as an extra ``fp=<hex8>`` token — old readers take
+    ``int(header[0])`` and ignore trailing tokens, so a fingerprinting
+    server stays readable by a pre-integrity head."""
     cost = np.asarray(cost, np.int64)
     plen = np.asarray(plen, np.int64)
     fin = np.asarray(finished).astype(np.int64)
     buf = io.BytesIO()
-    buf.write(f"{len(cost)}\n".encode())
+    header = f"{len(cost)}"
+    if fp is not None:
+        header += f" fp={int(fp) & 0xFFFFFFFF:08x}"
+    buf.write((header + "\n").encode())
     np.savetxt(buf, np.stack([cost, plen, fin], axis=1), fmt="%d")
     atomic_replace_bytes(path, buf.getvalue())
 
 
 def read_results_file(path: str) -> tuple[np.ndarray, np.ndarray,
                                           np.ndarray]:
-    """Returns ``(cost [Q] int64, plen [Q] int64, finished [Q] bool)``."""
+    """Returns ``(cost [Q] int64, plen [Q] int64, finished [Q] bool)``.
+
+    When the header carries an ``fp=`` fingerprint token the answer
+    bytes are re-checked before being returned; a mismatch raises
+    :class:`~..integrity.fingerprint.FingerprintError` (a ``ValueError``
+    subclass, so pre-integrity decode-error handlers still fail over)
+    and books ``answer_fp_mismatch_total`` — a corrupted sidecar is
+    never handed up."""
     with open(path) as f:
         header = f.readline().split()
         if not header:
@@ -352,14 +381,31 @@ def read_results_file(path: str) -> tuple[np.ndarray, np.ndarray,
             # dispatcher translates, not an opaque IndexError
             raise ValueError(f"{path}: empty results file")
         count = int(header[0])
+        fp_want = None
+        for tok in header[1:]:
+            if tok.startswith("fp="):
+                fp_want = int(tok[3:], 16)
         if count == 0:
-            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
-                    np.zeros(0, bool))
-        out = np.loadtxt(f, dtype=np.int64, ndmin=2)
+            out = np.zeros((0, 3), np.int64)
+        else:
+            out = np.loadtxt(f, dtype=np.int64, ndmin=2)
     if out.shape != (count, 3):
         raise ValueError(f"{path}: header says {(count, 3)}, "
                          f"found {out.shape}")
-    return out[:, 0], out[:, 1], out[:, 2] != 0
+    cost, plen, fin = out[:, 0], out[:, 1], out[:, 2] != 0
+    if fp_want is not None:
+        # lazy import: legacy (fingerprint-less) decode stays free of
+        # the integrity package entirely
+        from ..integrity.fingerprint import (
+            FingerprintError, M_FP_MISMATCH, answer_fingerprint)
+        got = answer_fingerprint(cost, plen, fin)
+        if got != fp_want:
+            M_FP_MISMATCH.inc()
+            raise FingerprintError(
+                f"{path}: answer fingerprint mismatch (header "
+                f"{fp_want:08x}, computed {got:08x}) — corrupted "
+                "results sidecar")
+    return cost, plen, fin
 
 
 # ----------------------------------------------------------- query files

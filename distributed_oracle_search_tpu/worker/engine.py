@@ -39,6 +39,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import quantiles as obs_quantiles
 from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
+from ..testing import faults
 from ..transport.wire import RuntimeConfig, StatsRow
 from ..utils.env import env_cast
 from ..utils.locks import OrderedLock
@@ -243,9 +244,16 @@ class ShardEngine:
         self.resident_codec = "raw"
         self.resident_bytes = 0
         if alg == "table-search":  # astar needs no first-move shard
-            self.fm = self._make_resident(load_shard_rows(
+            rows = load_shard_rows(
                 outdir, self.shard, dc=dc, graph=graph,
-                replica=self.replica))
+                replica=self.replica)
+            if faults.inject("corrupt-resident", self.shard) is not None:
+                # flip row 0 AFTER the digest-verified load: in-memory
+                # rot no manifest check can see — only the scrubber's
+                # dense-row compare (integrity.scrub) catches it
+                rows = np.array(rows, np.int8, copy=True)
+                rows[0, :] = np.where(rows[0, :] <= 0, 1, 0)
+            self.fm = self._make_resident(rows)
             owned = dc.owned(self.shard)
             if len(owned) != self.fm.shape[0]:
                 raise ValueError(

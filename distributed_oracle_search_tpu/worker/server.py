@@ -28,6 +28,7 @@ import threading
 import numpy as np
 
 from ..data.graph import Graph
+from ..integrity.fingerprint import answer_fingerprint
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..parallel.partition import DistributionController
@@ -300,8 +301,18 @@ class FifoServer:
             # transport.wire.results_file_for). The guard preserves the
             # pre-refactor shape exactly: an engine-less empty batch
             # answered the empty row without materializing a sidecar
+            fp = None
+            if req.config.answer_fp:
+                # fingerprint at answer birth (integrity wire
+                # extension); the corrupt-answer fault fires AFTER, so
+                # the head's verifier is what must catch the rot
+                fp = answer_fingerprint(cost, plen, fin)
+                if faults.inject("corrupt-answer", self.wid) is not None:
+                    cost = np.array(cost, np.int64, copy=True)
+                    if len(cost):
+                        cost[0] ^= 1
             write_results_file(results_file_for(req.queryfile),
-                               cost, plen, fin)
+                               cost, plen, fin, fp=fp)
         return stats
 
     def answer_queries(self, queries: np.ndarray, config, difffile: str):
@@ -1164,9 +1175,22 @@ class RpcServeLoop:
                 fs._batches = getattr(fs, "_batches", 0) + 1
                 if rconf.results:
                     header["res"] = True
-                    arrays += [np.asarray(cost, np.int64),
-                               np.asarray(plen, np.int64),
-                               np.asarray(fin).astype(np.uint8)]
+                    cost = np.asarray(cost, np.int64)
+                    plen = np.asarray(plen, np.int64)
+                    fin_u8 = np.asarray(fin).astype(np.uint8)
+                    if rconf.answer_fp:
+                        # integrity wire extension: fingerprint the
+                        # segments at birth, ride the reply header; the
+                        # corrupt-answer fault fires AFTER so the
+                        # head's check is what must catch it
+                        header["fp"] = answer_fingerprint(
+                            cost, plen, fin_u8)
+                        if faults.inject("corrupt-answer",
+                                         fs.wid) is not None:
+                            cost = cost.copy()
+                            if len(cost):
+                                cost[0] ^= 1
+                    arrays += [cost, plen, fin_u8]
                 if paths is not None:
                     header["paths"] = True
                     arrays += [np.asarray(paths[0], np.int64),

@@ -392,6 +392,142 @@ def test_engine_promotion_never_heals_with_freeflow_graph(
     assert not os.path.exists(victim + ".quarantined")
 
 
+def _promoted_world(tmp_path, toy_graph, toy_dc):
+    from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+    old = str(tmp_path / "old")
+    _build_all(toy_graph, toy_dc, old)
+    fused = _hot_diff(tmp_path, toy_graph, [26], mult=7)
+    rep = delta_build_index(toy_graph, toy_dc, old, fused)
+    eng = ShardEngine(toy_graph, toy_dc, 0, old)
+    assert eng.promote_index(rep["outdir"], rep["epoch"])
+    rng = np.random.default_rng(5)
+    owned = toy_dc.owned(0)
+    queries = np.stack([rng.integers(0, toy_graph.n, 16),
+                        rng.choice(owned, 16)], axis=1)
+    return eng, old, fused, rep, queries
+
+
+def test_scrub_rebind_under_serve_never_tears_epoch_gate(
+        tmp_path, toy_graph, toy_dc):
+    """Heal-under-serve: a scrubber rebinding BOTH tables in a tight
+    loop while a serving thread answers epoch and free-flow batches —
+    every answer stays bit-correct for its regime (the ``(epoch,
+    table)`` gate pair never tears) and the promotion survives."""
+    import threading
+
+    from distributed_oracle_search_tpu.integrity.scrub import _rebind
+    from distributed_oracle_search_tpu.transport.wire import (
+        RuntimeConfig,
+    )
+    from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+    eng, old, fused, rep, queries = _promoted_world(
+        tmp_path, toy_graph, toy_dc)
+    scratch = str(tmp_path / "scratch")
+    _build_all(_retimed(toy_graph, fused), toy_dc, scratch)
+    ref = ShardEngine(toy_graph, toy_dc, 0, scratch)
+    want = [np.asarray(a) for a in
+            ref.answer(queries, RuntimeConfig(), difffile=fused)[:3]]
+    base = ShardEngine(toy_graph, toy_dc, 0, old)
+    want_ff = [np.asarray(a) for a in
+               base.answer(queries, RuntimeConfig())[:3]]
+    stop = threading.Event()
+    bad = []
+
+    def serve():
+        while not stop.is_set():
+            got = eng.answer(queries, RuntimeConfig(), difffile=fused)
+            if not all((np.asarray(a) == b).all()
+                       for a, b in zip(got[:3], want)):
+                bad.append("epoch answers tore")
+                return
+            got_ff = eng.answer(queries, RuntimeConfig())
+            if not all((np.asarray(a) == b).all()
+                       for a, b in zip(got_ff[:3], want_ff)):
+                bad.append("free-flow leaked epoch moves")
+                return
+
+    t = threading.Thread(target=serve)
+    t.start()
+    try:
+        for _ in range(25):
+            assert _rebind(eng, None)
+            assert _rebind(eng, rep["epoch"])
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not bad
+    assert eng.index_epoch == rep["epoch"]
+
+
+def test_scrubber_heals_corrupted_promoted_resident_same_epoch(
+        tmp_path, toy_graph, toy_dc):
+    """Resident rot in a PROMOTED table heals from the epoch index
+    itself (promote_index's no-freeflow-heal rule), same epoch, serving
+    uninterrupted — never by dropping back to the base regime."""
+    from distributed_oracle_search_tpu.integrity.scrub import (
+        TableScrubber,
+    )
+    from distributed_oracle_search_tpu.transport.wire import (
+        RuntimeConfig,
+    )
+    from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+    eng, old, fused, rep, queries = _promoted_world(
+        tmp_path, toy_graph, toy_dc)
+    want = [np.asarray(a) for a in
+            eng.answer(queries, RuntimeConfig(), difffile=fused)[:3]]
+    epoch, table = eng._fm_promoted
+    rotted = np.array(np.asarray(table), np.int8, copy=True)
+    rotted[0, :] = np.where(rotted[0, :] <= 0, 1, 0)
+    eng._fm_promoted = (epoch, rotted)
+    scr = TableScrubber(lambda: [eng], interval_s=3600.0)
+    scr.run_pass()
+    assert scr.corrupt_blocks >= 1
+    assert eng._fm_promoted is not None
+    assert eng._fm_promoted[0] == epoch     # healed IN regime
+    got = eng.answer(queries, RuntimeConfig(), difffile=fused)
+    for a, b in zip(got[:3], want):
+        assert (np.asarray(a) == b).all()
+
+
+def test_scrub_unreloadable_epoch_drops_promotion_to_clean_base(
+        tmp_path, toy_graph, toy_dc):
+    """Rotted promoted resident whose epoch index is ALSO damaged
+    (manifest and a block lost): the rebind drops the promotion (an
+    epoch index must never heal from the free-flow graph) and epoch
+    traffic degrades to the clean base table instead of serving rot."""
+    from distributed_oracle_search_tpu.integrity.scrub import (
+        TableScrubber,
+    )
+    from distributed_oracle_search_tpu.transport.wire import (
+        RuntimeConfig,
+    )
+    from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+    eng, old, fused, rep, queries = _promoted_world(
+        tmp_path, toy_graph, toy_dc)
+    epoch, table = eng._fm_promoted
+    rotted = np.array(np.asarray(table), np.int8, copy=True)
+    rotted[0, :] = np.where(rotted[0, :] <= 0, 1, 0)
+    eng._fm_promoted = (epoch, rotted)
+    # the digests went with the manifest: detection falls back to the
+    # resident-vs-disk compare, and the reload cannot reassemble the
+    # shard (a whole block is gone)
+    os.unlink(os.path.join(rep["outdir"], "index.json"))
+    os.unlink(os.path.join(rep["outdir"], "cpd-w00000-b00001.npy"))
+    scr = TableScrubber(lambda: [eng], interval_s=3600.0)
+    scr.run_pass()
+    assert scr.corrupt_blocks >= 1
+    assert eng._fm_promoted is None
+    base = ShardEngine(toy_graph, toy_dc, 0, old)
+    want = base.answer(queries, RuntimeConfig(), difffile=fused)
+    got = eng.answer(queries, RuntimeConfig(), difffile=fused)
+    for a, b in zip(got[:3], want[:3]):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
 def test_delta_pruned_old_diff_degrades_to_full(tmp_path, toy_graph,
                                                 toy_dc):
     """Delta-on-delta chaining when the old index's recorded fused

@@ -281,6 +281,53 @@ def test_resubmit_dedup_replays_answered_frames(tmp_path):
         fe.stop()
 
 
+def test_clean_disconnect_purges_dedup_memo(tmp_path):
+    """Memo hygiene: a TORN connection keeps its dedup entries (the
+    client will reconnect and resubmit — the replay guarantee), but an
+    orderly EOF at a frame boundary purges them (that client is done;
+    nothing will ever resubmit those ids). ``/statusz`` exposes the
+    memo occupancy so a leak is visible, not silent."""
+    fe = _frontend()
+    srv = GatewayServer(fe, fid=0, gconf=_gconf(tmp_path)).start()
+    try:
+        assert srv.statusz()["memo"] == {"entries": 0, "cap": 4096}
+        s1 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s1.connect(srv.socket_path)
+        r1, w1 = FrameReader(s1), FrameWriter(s1)
+        r1.read()                                   # hello
+        h, a = protocol.encode_pairs(5, [(3, 9)], cid="a" * 16)
+        w1.send(h, a)
+        first = r1.read()
+        assert srv.statusz()["memo"]["entries"] == 1
+        # die mid-frame: half a header, then gone — a torn transport,
+        # not a clean goodbye
+        s1.sendall(b"\x00\x01")
+        s1.close()
+        # the entry survived: the reconnect replays it verbatim
+        d0 = _counter("gateway_resubmits_deduped_total")
+        s2 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s2.connect(srv.socket_path)
+        r2, w2 = FrameReader(s2), FrameWriter(s2)
+        r2.read()                                   # hello
+        h2 = dict(h)
+        h2["resubmit"] = True
+        w2.send(h2, a)
+        assert pair_rows(r2.read()) == pair_rows(first)
+        assert _counter("gateway_resubmits_deduped_total") - d0 == 1
+        assert srv.statusz()["memo"]["entries"] == 1
+        # orderly EOF at a frame boundary: the server forgets the
+        # connection's ids once the writer drains
+        s2.close()
+        deadline = time.monotonic() + 10
+        while (srv.statusz()["memo"]["entries"]
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert srv.statusz()["memo"]["entries"] == 0
+    finally:
+        srv.stop()
+        fe.stop()
+
+
 # -------------------------------------- per-request deadline from submit
 
 def test_wait_honors_deadline_from_submit_time(tmp_path):

@@ -1,0 +1,945 @@
+"""Answer-integrity plane: resident-table scrubbing, sampled
+dual-execution audit, answer fingerprints, and divergence quarantine.
+
+Non-slow: fingerprint byte-layout stability and the verify points
+(results sidecar, in-process ``_fp_guard``, cache hit re-check), the
+scrubber's detect→heal→rebind mechanics (resident rot, disk rot,
+budgeted cursors, the ``corrupt-resident`` fault point end to end),
+the audit sampler's deterministic cadence and lane choice (replica /
+reference / recompute, queue-full drop), the ``DivergenceWatch`` →
+quarantine → scrub-now → readmit control arm (executed and dry-run),
+the ``dos-make-cpds --scrub`` cadence exit codes, and the obs/bench
+key pins. The full corruption chaos drill (both fault points under a
+live ControlDaemon) stays behind ``slow``.
+"""
+
+import os
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from distributed_oracle_search_tpu.cli import make_cpds
+from distributed_oracle_search_tpu.control.daemon import (
+    ControlDaemon, maybe_daemon,
+)
+from distributed_oracle_search_tpu.control.actuators import Actuators
+from distributed_oracle_search_tpu.control.config import ControlConfig
+from distributed_oracle_search_tpu.control.policy import DivergenceWatch
+from distributed_oracle_search_tpu.data import (
+    ensure_synth_dataset, read_scen,
+)
+from distributed_oracle_search_tpu.data.graph import Graph
+from distributed_oracle_search_tpu.integrity import IntegrityConfig
+from distributed_oracle_search_tpu.integrity.audit import (
+    AnswerAuditor, choose_audit_lane, make_reference_fn,
+)
+from distributed_oracle_search_tpu.integrity.fingerprint import (
+    FingerprintError, answer_fingerprint, value_fingerprint,
+)
+from distributed_oracle_search_tpu.integrity.scrub import (
+    TableScrubber, _rebind, scrub_engine_table,
+)
+from distributed_oracle_search_tpu.models.cpd import (
+    build_worker_shard, shard_block_name, write_index_manifest,
+)
+from distributed_oracle_search_tpu.obs import fleet as obs_fleet
+from distributed_oracle_search_tpu.obs import metrics as obs_metrics
+from distributed_oracle_search_tpu.obs import recorder as obs_recorder
+from distributed_oracle_search_tpu.parallel.partition import (
+    DistributionController,
+)
+from distributed_oracle_search_tpu.serving import (
+    EngineDispatcher, HedgeConfig, ResultCache, ServeConfig,
+    ServingFrontend,
+)
+from distributed_oracle_search_tpu.serving.dispatch import (
+    DispatchError, _fp_guard,
+)
+from distributed_oracle_search_tpu.testing import faults
+from distributed_oracle_search_tpu.transport.resilience import (
+    BreakerRegistry,
+)
+from distributed_oracle_search_tpu.transport.wire import (
+    RuntimeConfig, read_results_file, write_results_file,
+)
+from distributed_oracle_search_tpu.utils.config import ClusterConfig
+from distributed_oracle_search_tpu.worker.build import main as build_main
+from distributed_oracle_search_tpu.worker.engine import ShardEngine
+
+pytestmark = pytest.mark.integrity
+
+N_WORKERS = 4
+BLOCK_SIZE = 4
+
+
+def _counter(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot()["counters"].get(name, 0)
+
+
+def _build_all(graph, dc, outdir):
+    for wid in range(dc.maxworker):
+        build_worker_shard(graph, dc, wid, outdir)
+    write_index_manifest(outdir, dc)
+
+
+@pytest.fixture()
+def toy_dc(toy_graph):
+    return DistributionController("tpu", N_WORKERS, N_WORKERS,
+                                  toy_graph.n, block_size=BLOCK_SIZE)
+
+
+@pytest.fixture()
+def toy_engine(tmp_path, toy_graph, toy_dc):
+    outdir = str(tmp_path / "idx")
+    _build_all(toy_graph, toy_dc, outdir)
+    return ShardEngine(toy_graph, toy_dc, 0, outdir), outdir
+
+
+def _rot_resident(eng):
+    """Flip row 0 of the RESIDENT table only (disk stays clean) —
+    exactly what the ``corrupt-resident`` fault point does post-load."""
+    clean = np.array(np.asarray(eng.fm), np.int8, copy=True)
+    bad = clean.copy()
+    bad[0, :] = np.where(bad[0, :] <= 0, 1, 0)
+    eng.fm = bad
+    return clean
+
+
+# ---------------------------------------------------------- fingerprints
+
+def test_answer_fingerprint_dtype_stable():
+    """The canonical byte layout is dtype- and container-independent:
+    every transport fingerprints the same bytes."""
+    fp = answer_fingerprint([3, 0, 7], [2, 0, 4], [True, False, True])
+    assert fp == answer_fingerprint(
+        np.array([3, 0, 7], np.int32), np.array([2, 0, 4], np.int64),
+        np.array([1, 0, 1], np.uint8))
+    assert fp != answer_fingerprint([3, 0, 7], [2, 0, 4],
+                                    [True, False, False])
+    assert value_fingerprint((3, 2, True)) == answer_fingerprint(
+        [3], [2], [True])
+
+
+def test_results_file_fingerprint_round_trip(tmp_path):
+    path = str(tmp_path / "results")
+    cost = np.array([5, 9], np.int64)
+    plen = np.array([2, 3], np.int64)
+    fin = np.array([True, True])
+    write_results_file(path, cost, plen, fin,
+                       fp=answer_fingerprint(cost, plen, fin))
+    c, p, f = read_results_file(path)
+    np.testing.assert_array_equal(c, cost)
+    np.testing.assert_array_equal(p, plen)
+    # a tampered answer row fails typed, and books the counter
+    lines = open(path).read().splitlines()
+    lines[1] = "6 2 1"                       # cost 5 -> 6
+    open(path, "w").write("\n".join(lines) + "\n")
+    m0 = _counter("answer_fp_mismatch_total")
+    with pytest.raises(FingerprintError):
+        read_results_file(path)
+    assert _counter("answer_fp_mismatch_total") - m0 == 1
+
+
+def test_results_file_without_fp_stays_legacy(tmp_path):
+    """No ``fp=`` token -> no verification: a tampered legacy sidecar
+    still parses (pre-integrity behavior, byte for byte)."""
+    path = str(tmp_path / "results")
+    write_results_file(path, [5], [2], [True])
+    assert "fp=" not in open(path).readline()
+    lines = open(path).read().splitlines()
+    lines[1] = "6 2 1"
+    open(path, "w").write("\n".join(lines) + "\n")
+    c, _, _ = read_results_file(path)
+    assert c.tolist() == [6]
+
+
+def test_fp_guard_catches_injected_corruption(monkeypatch):
+    monkeypatch.setenv("DOS_FAULTS", "corrupt-answer;times=1")
+    faults.reset()
+    cost = np.arange(4, dtype=np.int64)
+    plen = np.ones(4, np.int64)
+    fin = np.ones(4, bool)
+    m0 = _counter("answer_fp_mismatch_total")
+    with pytest.raises(DispatchError, match="fingerprint"):
+        _fp_guard(0, cost, plen, fin, RuntimeConfig(answer_fp=True))
+    assert _counter("answer_fp_mismatch_total") - m0 == 1
+    # the injection is consumed: the retry lane verifies clean
+    c2, p2, f2 = _fp_guard(0, cost, plen, fin,
+                           RuntimeConfig(answer_fp=True))
+    np.testing.assert_array_equal(c2, cost)
+
+
+def test_fp_guard_off_is_identity_and_consumes_nothing(monkeypatch):
+    monkeypatch.setenv("DOS_FAULTS", "corrupt-answer;times=1")
+    faults.reset()
+    cost = np.arange(3, dtype=np.int64)
+    plen = np.ones(3, np.int64)
+    fin = np.ones(3, bool)
+    out = _fp_guard(0, cost, plen, fin, RuntimeConfig())
+    assert out[0] is cost and out[1] is plen and out[2] is fin
+    # the armed fault was NOT consumed by the disabled guard
+    assert faults.inject("corrupt-answer", 0) is not None
+
+
+# ---------------------------------------------------------- cache checks
+
+def test_cache_fingerprint_drops_rotted_entry():
+    cache = ResultCache(1 << 20, fingerprint=True)
+    key = (3, 9, "-", (), 0, 0)
+    cache.put(key, (7, 2, True))
+    assert cache.get(key) == (7, 2, True)
+    m0 = _counter("cache_fingerprint_mismatch_total")
+    with cache._lock:
+        cache._od[key] = (8, 2, True)       # in-memory rot
+    assert cache.get(key) is None           # dropped, booked as a miss
+    assert _counter("cache_fingerprint_mismatch_total") - m0 == 1
+    assert cache.fp_mismatches == 1
+    assert len(cache) == 0                  # the entry is gone
+    # the recompute path re-populates and hits again
+    cache.put(key, (7, 2, True))
+    assert cache.get(key) == (7, 2, True)
+
+
+def test_cache_without_fingerprint_stays_legacy():
+    cache = ResultCache(1 << 20)
+    key = (3, 9, "-", (), 0, 0)
+    cache.put(key, (7, 2, True))
+    with cache._lock:
+        cache._od[key] = (8, 2, True)
+    assert cache.get(key) == (8, 2, True)   # served as-is (no check)
+    assert cache.fp_mismatches == 0
+
+
+# -------------------------------------------------------------- scrubber
+
+def test_scrub_clean_pass_checks_everything(toy_engine):
+    eng, outdir = toy_engine
+    report, cur = scrub_engine_table(eng, outdir, eng.fm, None)
+    assert cur == (0, 0)
+    assert report["checked"] == 3           # 12 owned rows / block 4
+    assert not report["corrupt"] and not report["healed"]
+    assert not report["rebound"] and not report["errors"]
+
+
+def test_scrub_detects_resident_rot_and_rebinds(tmp_path, toy_engine):
+    eng, outdir = toy_engine
+    clean = _rot_resident(eng)
+    rec = obs_recorder.FlightRecorder(str(tmp_path / "tape"),
+                                      flush_every=1)
+    obs_recorder.set_recorder(rec)
+    c0 = _counter("scrub_blocks_corrupt_total")
+    try:
+        report, cur = scrub_engine_table(eng, outdir, eng.fm, None)
+    finally:
+        obs_recorder.set_recorder(None)
+        rec.close()
+    assert report["corrupt"] == [shard_block_name(0, 0, 0)]
+    assert report["rebound"] and cur == (0, 0)
+    # the rebind republished the verified disk truth
+    np.testing.assert_array_equal(np.asarray(eng.fm, np.int8), clean)
+    assert _counter("scrub_blocks_corrupt_total") - c0 == 1
+    events = [r for r in obs_recorder.replay(str(tmp_path / "tape"))
+              if r.get("rec") == "event" and r["kind"] == "scrub_corrupt"]
+    assert len(events) == 1
+    assert events[0]["shard"] == 0
+    assert events[0]["file"] == shard_block_name(0, 0, 0)
+
+
+def test_scrub_heals_disk_rot_resident_stays_authoritative(toy_engine):
+    eng, outdir = toy_engine
+    resident = np.array(np.asarray(eng.fm), np.int8, copy=True)
+    victim = shard_block_name(0, 1, 0)
+    with open(os.path.join(outdir, victim), "r+b") as f:
+        f.seek(130)
+        f.write(b"\x7f" * 4)
+    report, _ = scrub_engine_table(eng, outdir, eng.fm, None)
+    assert report["healed"] == [victim]
+    assert not report["corrupt"] and not report["rebound"]
+    np.testing.assert_array_equal(np.asarray(eng.fm, np.int8), resident)
+    # the healed file verifies on the next pass
+    report2, _ = scrub_engine_table(eng, outdir, eng.fm, None)
+    assert report2["checked"] == 3 and not report2["healed"]
+    assert not report2["errors"]
+
+
+def test_scrub_budget_cursor_resumes_and_wraps(toy_engine):
+    eng, outdir = toy_engine
+    report, cur = scrub_engine_table(eng, outdir, eng.fm, None,
+                                     budget=1)
+    assert report["checked"] == 1 and cur == (1, BLOCK_SIZE)
+    report, cur = scrub_engine_table(eng, outdir, eng.fm, None,
+                                     budget=1, cursor=cur)
+    assert report["checked"] == 1 and cur == (2, 2 * BLOCK_SIZE)
+    report, cur = scrub_engine_table(eng, outdir, eng.fm, None,
+                                     budget=1, cursor=cur)
+    assert report["checked"] == 1 and cur == (0, 0)     # wrapped
+
+
+def test_corrupt_resident_fault_point_end_to_end(tmp_path, toy_graph,
+                                                 toy_dc, monkeypatch):
+    """The ``corrupt-resident`` fault point plants rot the digest-
+    verified load cannot see; the scrubber is the ONLY defense that
+    catches it — and after rebind the engine answers match a clean
+    engine bit for bit."""
+    outdir = str(tmp_path / "idx")
+    _build_all(toy_graph, toy_dc, outdir)
+    clean_eng = ShardEngine(toy_graph, toy_dc, 0, outdir)
+    monkeypatch.setenv("DOS_FAULTS", "corrupt-resident;wid=0;times=1")
+    faults.reset()
+    eng = ShardEngine(toy_graph, toy_dc, 0, outdir)
+    owned = toy_dc.owned(0)
+    queries = np.array([[int(owned[-1]), int(owned[0])],
+                        [0, int(owned[0])]], np.int64)
+    want = clean_eng.answer(queries, RuntimeConfig())
+    got_bad = eng.answer(queries, RuntimeConfig())
+    assert (np.asarray(got_bad[0]) != np.asarray(want[0])).any()
+    scr = TableScrubber(lambda: [eng, clean_eng], interval_s=3600.0)
+    reports = scr.run_pass()
+    assert scr.corrupt_blocks == 1          # only the rotted engine
+    assert sum(r["rebound"] for r in reports) == 1
+    got = eng.answer(queries, RuntimeConfig())
+    for a, b in zip(got[:3], want[:3]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_table_scrubber_thread_scrub_now_and_statusz(toy_engine):
+    eng, _ = toy_engine
+    p0 = _counter("scrub_passes_total")
+    scr = TableScrubber(lambda: [eng], interval_s=3600.0)
+    scr.start()
+    try:
+        scr.scrub_now()                     # wake well before interval
+        deadline = time.monotonic() + 10
+        while scr.passes == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert scr.passes >= 1
+        st = scr.statusz()
+        assert st["corrupt_blocks"] == 0 and st["healed_blocks"] == 0
+        assert st["last"][0]["shard"] == 0
+        assert st["last"][0]["checked"] == 3
+    finally:
+        scr.stop()
+    assert _counter("scrub_passes_total") - p0 >= 1
+    assert "dos-scrub" not in [t.name for t in threading.enumerate()
+                               if t.is_alive()]
+
+
+def test_scrubber_skips_astar_and_unloaded_engines(toy_engine):
+    eng, _ = toy_engine
+    no_fm = types.SimpleNamespace(alg="table-search", fm=None)
+    astar = types.SimpleNamespace(alg="astar", fm=object())
+    scr = TableScrubber(lambda: [no_fm, astar, eng], interval_s=3600.0)
+    reports = scr.run_pass()
+    assert [r["shard"] for r in reports] == [0]
+
+
+def test_rebind_loses_to_newer_promotion(toy_engine):
+    """A rebind racing a newer promotion must not clobber it: the
+    epoch check under ``_promote_lock`` refuses the stale swap."""
+    eng, _ = toy_engine
+    table = np.asarray(eng.fm)
+    eng._fm_promoted = (7, table)
+    assert not _rebind(eng, 5)              # 5 lost the race to 7
+    assert eng._fm_promoted == (7, table)
+
+
+# ----------------------------------------------------------------- audit
+
+class _EchoDispatcher:
+    """Audit-lane stub: records the call, returns what the maker says
+    (defaults to echoing cost = |s - t| like the gateway stubs)."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+        self.calls = []
+
+    def answer_batch(self, wid, queries, rconf, diff, via=None):
+        q = np.asarray(queries)
+        self.calls.append((int(wid), via, rconf, diff))
+        if self.fn is not None:
+            return self.fn(q)
+        return (np.abs(q[:, 0] - q[:, 1]).astype(np.int64),
+                np.ones(len(q), np.int64), np.ones(len(q), bool))
+
+
+def _served(q):
+    q = np.asarray(q)
+    return (np.abs(q[:, 0] - q[:, 1]).astype(np.int64),
+            np.ones(len(q), np.int64), np.ones(len(q), bool))
+
+
+def test_choose_audit_lane_preference_order():
+    lane, why = choose_audit_lane((0, 1), 0, 8, have_reference=True,
+                                  max_reference=64)
+    assert lane == "replica" and "candidate 1" in why
+    lane, why = choose_audit_lane((0,), 0, 8, have_reference=True,
+                                  max_reference=64)
+    assert lane == "reference"
+    lane, why = choose_audit_lane((0,), 0, 100, have_reference=True,
+                                  max_reference=64)
+    assert lane == "recompute"
+    lane, why = choose_audit_lane((0,), 0, 8, have_reference=False,
+                                  max_reference=64)
+    assert lane == "recompute" and "no reference fn" in why
+
+
+def test_audit_sampling_is_deterministic():
+    """DOS_AUDIT_RATE=250 audits EXACTLY every 4th eligible batch (an
+    accumulator, no RNG); deadline-bounded batches are never sampled."""
+    aud = AnswerAuditor(_EchoDispatcher(), 250)
+    try:
+        q = np.array([[3, 9]], np.int64)
+        c, p, f = _served(q)
+        got = [aud.maybe_submit(0, 0, (0,), q, RuntimeConfig(), "-",
+                                c, p, f) for _ in range(8)]
+        assert got == [False, False, False, True] * 2
+        # config.time != 0 -> never eligible, accumulator untouched
+        assert not aud.maybe_submit(0, 0, (0,), q,
+                                    RuntimeConfig(time=5), "-", c, p, f)
+    finally:
+        aud.stop()
+    assert "dos-audit" not in [t.name for t in threading.enumerate()
+                               if t.is_alive()]
+
+
+def test_audit_rate_zero_never_starts_a_thread():
+    aud = AnswerAuditor(_EchoDispatcher(), 0)
+    q = np.array([[3, 9]], np.int64)
+    c, p, f = _served(q)
+    assert not aud.maybe_submit(0, 0, (0,), q, RuntimeConfig(), "-",
+                                c, p, f)
+    assert "dos-audit" not in [t.name for t in threading.enumerate()
+                               if t.is_alive()]
+    aud.stop()                              # harmless no-op
+
+
+def test_audit_replica_lane_detects_divergence(tmp_path):
+    """The replica lane disagrees with the served answers: the
+    divergence books the counter, the per-shard tally, and a recorder
+    event carrying the lane-choice provenance."""
+    disp = _EchoDispatcher(fn=lambda q: (
+        np.abs(q[:, 0] - q[:, 1]).astype(np.int64) + 1,    # diverges
+        np.ones(len(q), np.int64), np.ones(len(q), bool)))
+    rec = obs_recorder.FlightRecorder(str(tmp_path / "tape"),
+                                      flush_every=1)
+    obs_recorder.set_recorder(rec)
+    d0 = _counter("audit_divergence_total")
+    a0 = _counter("audit_batches_total")
+    aud = AnswerAuditor(disp, 1000)
+    try:
+        q = np.array([[3, 9], [1, 8]], np.int64)
+        c, p, f = _served(q)
+        assert aud.maybe_submit(0, 0, (0, 1), q, RuntimeConfig(), "-",
+                                c, p, f)
+        deadline = time.monotonic() + 10
+        while aud.audited == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        aud.stop()
+        obs_recorder.set_recorder(None)
+        rec.close()
+    assert _counter("audit_batches_total") - a0 == 1
+    assert _counter("audit_divergence_total") - d0 == 1
+    assert aud.snapshot() == {0: 1}
+    st = aud.statusz()
+    assert st["audited"] == 1 and st["divergent"] == {"0": 1}
+    # the lane went to the replica, uncached (no L2 self-echo)
+    wid, via, rconf, _ = disp.calls[0]
+    assert (wid, via) == (0, 1) and rconf.no_cache
+    events = [r for r in obs_recorder.replay(str(tmp_path / "tape"))
+              if r.get("rec") == "event"
+              and r["kind"] == "audit_divergence"]
+    assert len(events) == 1
+    assert events[0]["lane"] == "replica"
+    assert events[0]["mismatches"] == 2 and events[0]["nq"] == 2
+
+
+def test_audit_reference_and_recompute_lanes():
+    disp = _EchoDispatcher()
+    ref_calls = []
+
+    def ref_fn(queries, config, diff):
+        ref_calls.append(len(queries))
+        return _served(queries)
+
+    aud = AnswerAuditor(disp, 1000, reference_fn=ref_fn,
+                        max_reference=2)
+    try:
+        # small single-candidate batch -> the CPU reference oracle
+        q = np.array([[3, 9]], np.int64)
+        c, p, f = _served(q)
+        assert aud.maybe_submit(0, 0, (0,), q, RuntimeConfig(), "-",
+                                c, p, f)
+        # big single-candidate batch -> uncached recompute on via
+        q2 = np.array([[3, 9], [1, 8], [2, 7]], np.int64)
+        c2, p2, f2 = _served(q2)
+        assert aud.maybe_submit(0, 0, (0,), q2, RuntimeConfig(), "-",
+                                c2, p2, f2)
+        deadline = time.monotonic() + 10
+        while aud.audited < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        aud.stop()
+    assert ref_calls == [1]
+    assert len(disp.calls) == 1             # only the recompute lane
+    wid, via, rconf, _ = disp.calls[0]
+    assert (wid, via) == (0, 0) and rconf.no_cache
+    assert aud.snapshot() == {}             # both lanes agreed
+
+
+def test_audit_queue_full_drops_never_blocks():
+    release = threading.Event()
+
+    def blocked(q):
+        release.wait(30.0)
+        return _served(q)
+
+    aud = AnswerAuditor(_EchoDispatcher(fn=blocked), 1000, queue_max=1)
+    try:
+        q = np.array([[3, 9]], np.int64)
+        c, p, f = _served(q)
+        assert aud.maybe_submit(0, 0, (0, 1), q, RuntimeConfig(), "-",
+                                c, p, f)
+        # wait until the worker picked job 1 up and is blocked in it
+        deadline = time.monotonic() + 10
+        while aud._q.qsize() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert aud.maybe_submit(0, 0, (0, 1), q, RuntimeConfig(), "-",
+                                c, p, f)               # fills the queue
+        d0 = _counter("audit_dropped_total")
+        t0 = time.monotonic()
+        assert not aud.maybe_submit(0, 0, (0, 1), q, RuntimeConfig(),
+                                    "-", c, p, f)      # dropped
+        assert time.monotonic() - t0 < 1.0             # no backpressure
+        assert _counter("audit_dropped_total") - d0 == 1
+        assert aud.dropped == 1
+    finally:
+        release.set()
+        aud.stop()
+
+
+def test_reference_oracle_matches_engine(toy_engine, toy_graph,
+                                         toy_dc):
+    eng, _ = toy_engine
+    owned = toy_dc.owned(0)
+    queries = np.array([[0, int(owned[0])],
+                        [int(owned[-1]), int(owned[1])],
+                        [int(owned[0]), int(owned[0])]], np.int64)
+    ref = make_reference_fn(toy_graph)
+    c, p, f = ref(queries, RuntimeConfig(), "-")
+    want = eng.answer(queries, RuntimeConfig())
+    np.testing.assert_array_equal(c, np.asarray(want[0]))
+    np.testing.assert_array_equal(p, np.asarray(want[1]))
+    np.testing.assert_array_equal(f, np.asarray(want[2]))
+
+
+# ---------------------------------------------------------------- config
+
+def test_integrity_config_defaults_off(monkeypatch):
+    for k in ("DOS_SCRUB_INTERVAL_S", "DOS_SCRUB_BLOCKS_PER_PASS",
+              "DOS_AUDIT_RATE", "DOS_AUDIT_MAX_REFERENCE",
+              "DOS_ANSWER_FP"):
+        monkeypatch.delenv(k, raising=False)
+    cfg = IntegrityConfig.from_env()
+    assert cfg == IntegrityConfig()
+    assert not cfg.any_enabled
+
+
+def test_integrity_config_from_env_and_degrade(monkeypatch):
+    monkeypatch.setenv("DOS_SCRUB_INTERVAL_S", "30")
+    monkeypatch.setenv("DOS_AUDIT_RATE", "10")
+    monkeypatch.setenv("DOS_ANSWER_FP", "1")
+    cfg = IntegrityConfig.from_env()
+    assert cfg.scrub_interval_s == 30.0 and cfg.audit_rate == 10
+    assert cfg.answer_fp and cfg.any_enabled
+    # an impossible combination degrades to ALL defaults, not a crash
+    monkeypatch.setenv("DOS_AUDIT_RATE", "2000")
+    assert IntegrityConfig.from_env() == IntegrityConfig()
+    with pytest.raises(ValueError):
+        IntegrityConfig(audit_rate=-1).validate()
+
+
+# ---------------------------------------------------- divergence control
+
+def _sig(div):
+    return types.SimpleNamespace(audit_divergent=dict(div))
+
+
+def test_divergence_watch_acts_on_deltas_with_cooldown():
+    w = DivergenceWatch(cooldown_s=10.0)
+    out = w.decide(_sig({0: 1}), 100.0)
+    assert [(d[0], d[1]) for d in out] == [("divergence_quarantine", 0)]
+    assert "1 audit divergence" in out[0][2]
+    # same cumulative count: no fresh evidence, no decision
+    assert w.decide(_sig({0: 1}), 101.0) == []
+    # fresh divergence mid-cooldown is NOT swallowed: _seen does not
+    # advance, so it re-fires once the cooldown opens
+    assert w.decide(_sig({0: 3}), 105.0) == []
+    out = w.decide(_sig({0: 3}), 111.0)
+    assert len(out) == 1 and out[0][1] == 0
+    assert "2 audit divergence(s) (3 cumulative)" in out[0][2]
+
+
+def _icfg(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("hold_ticks", 1)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("clean_probes", 1)
+    return ControlConfig(**kw)
+
+
+class _StubAuditor:
+    def __init__(self):
+        self.div = {}
+
+    def snapshot(self):
+        return dict(self.div)
+
+
+def test_daemon_divergence_quarantine_scrub_then_readmit(tmp_path):
+    """The control arm end to end: an audit divergence force-opens the
+    shard's breaker, triggers scrub-now, and the shard earns its way
+    back through the SAME probation loop — causal chain on tape."""
+    rec = obs_recorder.FlightRecorder(str(tmp_path / "tape"),
+                                      flush_every=1)
+    obs_recorder.set_recorder(rec)
+    reg = BreakerRegistry(threshold=3, cooldown_s=600.0, enabled=True)
+    aud = _StubAuditor()
+    scrubbed = []
+    probe_ok = {"v": False}
+    d = ControlDaemon(_icfg(), registry=reg, breaker_key=lambda w: w,
+                      integrity=aud, scrub_fn=scrubbed.append,
+                      probe_fn=lambda w: probe_ok["v"])
+    q0 = _counter("control_divergence_quarantines_total")
+    try:
+        d.tick(now=100.0)
+        assert d.quarantine.quarantined() == []        # nothing yet
+        aud.div[1] = 1
+        d.tick(now=101.0)
+        assert d.quarantine.quarantined() == [1]
+        assert not reg.allow(1)                        # routed around
+        assert scrubbed == [1]                         # scrub-now fired
+        assert (_counter("control_divergence_quarantines_total")
+                - q0 == 1)
+        d.tick(now=102.0)                              # probe fails
+        assert d.quarantine.quarantined() == [1]
+        probe_ok["v"] = True
+        d.tick(now=103.0)                              # clean probe
+        assert d.quarantine.quarantined() == []
+        assert reg.allow(1)                            # released
+    finally:
+        reg.shutdown()
+        obs_recorder.set_recorder(None)
+        rec.close()
+    kinds = [r["kind"] for r in obs_recorder.replay(str(tmp_path / "tape"))
+             if r.get("rec") == "event"]
+    assert kinds.index("control_divergence_quarantine") \
+        < kinds.index("control_readmit")
+
+
+def test_daemon_divergence_dry_run_books_without_acting():
+    reg = BreakerRegistry(threshold=3, cooldown_s=600.0, enabled=True)
+    aud = _StubAuditor()
+    scrubbed = []
+    d = ControlDaemon(_icfg(dry_run=True), registry=reg,
+                      breaker_key=lambda w: w, integrity=aud,
+                      scrub_fn=scrubbed.append,
+                      probe_fn=lambda w: True)
+    q0 = _counter("control_divergence_quarantines_total")
+    try:
+        aud.div[0] = 2
+        d.tick(now=100.0)
+        assert d.quarantine.quarantined() == []        # never entered
+        assert reg.allow(0) and scrubbed == []         # nothing acted
+        assert (_counter("control_divergence_quarantines_total")
+                - q0 == 0)
+        assert d.last_action.startswith(
+            "divergence_quarantine(dry-run)")
+    finally:
+        reg.shutdown()
+
+
+def test_actuator_divergence_quarantine_wiring():
+    with pytest.raises(RuntimeError, match="registry"):
+        Actuators().divergence_quarantine(0, "why")
+    reg = BreakerRegistry(threshold=3, cooldown_s=600.0, enabled=True)
+    try:
+        def bad_scrub(shard):
+            raise RuntimeError("scrubber wedged")
+
+        act = Actuators(registry=reg, scrub_fn=bad_scrub)
+        act.divergence_quarantine(2, "audit said so")
+        assert not reg.allow(2)        # the breaker pin survived the
+    finally:                           # scrub hiccup (best-effort half)
+        reg.shutdown()
+
+
+def test_maybe_daemon_wires_integrity_providers(monkeypatch):
+    aud = _StubAuditor()
+    fn = lambda shard: None  # noqa: E731
+    monkeypatch.delenv("DOS_CONTROL", raising=False)
+    assert maybe_daemon(integrity=aud, scrub_fn=fn) is None
+    monkeypatch.setenv("DOS_CONTROL", "1")
+    monkeypatch.setenv("DOS_CONTROL_INTERVAL_S", "60")
+    d = maybe_daemon(integrity=aud, scrub_fn=fn)
+    try:
+        assert d is not None
+        assert d.signals.integrity is aud
+        assert d.actuators.scrub_fn is fn
+    finally:
+        d.stop()
+
+
+def test_signal_reader_degrades_on_broken_auditor():
+    class _Broken:
+        def snapshot(self):
+            raise RuntimeError("boom")
+
+    d = ControlDaemon(_icfg(), integrity=_Broken(),
+                      probe_fn=lambda w: True)
+    d.tick(now=100.0)                       # reads degrade, no crash
+    assert d.quarantine.quarantined() == []
+
+
+# ------------------------------------------------- dos-make-cpds --scrub
+
+def test_run_scrub_keeps_worst_exit_code(monkeypatch):
+    seen = []
+    seq = [4, 0, 0]
+    monkeypatch.setattr(make_cpds, "run_verify",
+                        lambda conf: seen.append(1) or seq.pop(0))
+    args = types.SimpleNamespace(scrub_passes=3, scrub_interval=0.0)
+    assert make_cpds.run_scrub(None, args) == 4     # rot seen once is
+    assert len(seen) == 3                           # rot, healed or not
+
+
+def test_make_cpds_scrub_exit_codes(tmp_path, toy_graph, toy_dc):
+    outdir = str(tmp_path / "idx")
+    _build_all(toy_graph, toy_dc, outdir)
+    # run_verify counts nodes off the xy file
+    toy_xy = str(tmp_path / "toy.xy")
+    from distributed_oracle_search_tpu.data.formats import write_xy
+    write_xy(toy_xy, toy_graph.xs, toy_graph.ys, toy_graph.src,
+             toy_graph.dst, toy_graph.w)
+    conf = ClusterConfig(
+        workers=["localhost"] * N_WORKERS, partmethod="tpu",
+        partkey=N_WORKERS, outdir=outdir, xy_file=toy_xy,
+        nfs=str(tmp_path),
+    ).validate()
+    args = types.SimpleNamespace(scrub_passes=1, scrub_interval=0.0)
+    assert make_cpds.run_scrub(conf, args) == 0         # clean
+    victim = os.path.join(outdir, shard_block_name(1, 0, 0))
+    os.unlink(victim)
+    assert make_cpds.run_scrub(conf, args) == 3         # degraded
+    open(os.path.join(outdir, "index.json"), "w").write("{")
+    assert make_cpds.run_scrub(conf, args) == 4         # corrupt
+
+
+def test_make_cpds_scrub_args_parse():
+    from distributed_oracle_search_tpu.cli.args import parse_args
+    args = parse_args([], prog="make_cpds")
+    assert args.scrub is False
+    assert args.scrub_interval == 60.0 and args.scrub_passes == 1
+    args = parse_args(["--scrub", "--scrub-interval", "0.5",
+                       "--scrub-passes", "0"], prog="make_cpds")
+    assert args.scrub and args.scrub_passes == 0
+    assert args.scrub_interval == 0.5
+
+
+# ------------------------------------------------------------- obs pins
+
+def test_fault_points_include_corruption_pair():
+    assert "corrupt-resident" in faults.POINTS
+    assert "corrupt-answer" in faults.POINTS
+    rules = faults.parse_faults(
+        "corrupt-resident;wid=0;times=1,corrupt-answer;times=2")
+    assert [r.point for r in rules] == ["corrupt-resident",
+                                       "corrupt-answer"]
+    with pytest.raises(ValueError):
+        faults.parse_faults("corrupt-everything")
+
+
+def test_obs_metric_map_covers_integrity_family():
+    import distributed_oracle_search_tpu.obs as obs
+
+    for name in ("scrub_blocks_checked_total", "scrub_blocks_corrupt_total",
+                 "scrub_passes_total", "scrub_pass_seconds",
+                 "audit_batches_total", "audit_divergence_total",
+                 "audit_dropped_total", "audit_lane_seconds",
+                 "answer_fp_mismatch_total",
+                 "cache_fingerprint_mismatch_total",
+                 "control_divergence_quarantines_total"):
+        assert name in obs.__doc__, name
+
+
+def test_bench_directions_and_tolerances_cover_integrity_family():
+    for k in ("integrity_audit_divergence",
+              "integrity_wrong_answers_served",
+              "integrity_audit_overhead_frac",
+              "integrity_scrub_overhead_frac",
+              "integrity_detect_seconds"):
+        assert obs_fleet._KEY_DIRECTIONS.get(k) == "lower", k
+        assert k in obs_fleet._KEY_TOLERANCES, k
+    for k in ("integrity_base_queries_per_sec",
+              "integrity_audit1_queries_per_sec",
+              "integrity_audit10_queries_per_sec",
+              "integrity_scrub_queries_per_sec"):
+        assert obs_fleet._KEY_DIRECTIONS.get(k) == "higher", k
+        assert k in obs_fleet._KEY_TOLERANCES, k
+    # correctness counters regress at ZERO tolerance: one wrong answer
+    # or one divergence is a failed diff, not noise
+    assert obs_fleet._KEY_TOLERANCES["integrity_audit_divergence"] == 0.0
+    assert obs_fleet._KEY_TOLERANCES[
+        "integrity_wrong_answers_served"] == 0.0
+
+
+# ------------------------------------------------- chaos drill (slow)
+
+@pytest.mark.slow
+def test_corruption_chaos_drill_zero_corrupt_answers(tmp_path_factory,
+                                                     tmp_path,
+                                                     monkeypatch):
+    """The acceptance drill: ``corrupt-resident`` + ``corrupt-answer``
+    under a live ControlDaemon. The audit's replica lane detects the
+    resident rot, the divergence quarantine pulls the shard (clients
+    fail over to the clean replica), scrub-now heals the table, the
+    probation loop re-admits — and the final answers are bit-identical
+    to the fault-free run, with the whole causal chain on the flight
+    recorder. The wire-rot half (``corrupt-answer``) is caught
+    synchronously by the fingerprint guard: the corrupted batch is
+    retried on the replica and a corrupt answer NEVER reaches a
+    client."""
+    datadir = str(tmp_path_factory.mktemp("chaos-data"))
+    paths = ensure_synth_dataset(datadir, width=8, height=6,
+                                 n_queries=32, seed=11)
+    outdir = os.path.join(datadir, "index")
+    for wid in range(2):
+        build_main(["--input", paths["xy"], "--partmethod", "mod",
+                    "--partkey", "2", "--workerid", str(wid),
+                    "--maxworker", "2", "--outdir", outdir,
+                    "--replication", "2"])
+    g = Graph.from_xy(paths["xy"])
+    dc = DistributionController("mod", 2, 2, g.n, replication=2)
+    write_index_manifest(outdir, dc)
+    conf = ClusterConfig(
+        workers=["localhost"] * 2, partmethod="mod", partkey=2,
+        outdir=outdir, xy_file=paths["xy"], scenfile=paths["scen"],
+        nfs=datadir, replication=2,
+    ).validate()
+    owned0 = dc.owned(0)
+    t_rot = int(owned0[0])          # the row corrupt-resident flips
+    pool = [(int(s), int(t)) for s, t in read_scen(paths["scen"])[:16]]
+    pool += [(int(s), t_rot) for s in (1, 5, 9, int(owned0[-1]))]
+    sconf = ServeConfig(max_wait_ms=1.0, cache_bytes=0)
+    rconf = RuntimeConfig(answer_fp=True)
+
+    # ---- fault-free truth run
+    fe_t = ServingFrontend(dc, EngineDispatcher(conf, graph=g, dc=dc),
+                           sconf=sconf, rconf=rconf,
+                           hconf=HedgeConfig(enabled=False))
+    fe_t.start()
+    try:
+        truth = {q: fe_t.query(*q, timeout=60) for q in pool}
+        assert all(r.ok for r in truth.values())
+    finally:
+        fe_t.stop()
+    truth = {q: (r.cost, r.plen, r.finished) for q, r in truth.items()}
+
+    # ---- armed run: shard 0's primary resident table rots at load
+    monkeypatch.setenv("DOS_FAULTS", "corrupt-resident;wid=0;times=1")
+    faults.reset()
+    rec = obs_recorder.FlightRecorder(str(tmp_path / "tape"),
+                                      flush_every=1)
+    obs_recorder.set_recorder(rec)
+    disp = EngineDispatcher(conf, graph=g, dc=dc)
+    reg = BreakerRegistry(threshold=3, cooldown_s=600.0, enabled=True)
+    fe = ServingFrontend(dc, disp, sconf=sconf, rconf=rconf,
+                         registry=reg, breaker_key=lambda w: w,
+                         hconf=HedgeConfig(enabled=False))
+    auditor = AnswerAuditor(disp, 1000,
+                            reference_fn=make_reference_fn(g))
+    fe.auditor = auditor
+    scrubber = TableScrubber(lambda: list(disp._engines.values()),
+                             interval_s=3600.0)
+    fe.scrubber = scrubber
+    # scrub-now runs synchronously inside the actuator: re-admission
+    # probes can only pass AFTER the heal had its say
+    daemon = ControlDaemon(
+        _icfg(interval_s=0.05), frontend=fe, registry=reg,
+        breaker_key=lambda w: w, integrity=auditor,
+        scrub_fn=lambda s: scrubber.run_pass(shards={s}, budget=0),
+        probe_fn=lambda w: True).start()
+    fe.start()
+    d0 = _counter("audit_divergence_total")
+    q0 = _counter("control_divergence_quarantines_total")
+    try:
+        # phase A: drive traffic through the rotted row until the loop
+        # detects, quarantines, heals and re-admits
+        for q in pool:
+            fe.query(*q, timeout=60)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            healed = scrubber.corrupt_blocks >= 1
+            calm = (auditor._q.qsize() == 0
+                    and not daemon.quarantine.quarantined()
+                    and reg.allow(0))
+            if healed and calm \
+                    and _counter("audit_divergence_total") > d0:
+                break
+            for q in pool[-4:]:             # keep the rot observable
+                fe.query(*q, timeout=60)
+            time.sleep(0.1)
+        assert _counter("audit_divergence_total") - d0 >= 1
+        assert (_counter("control_divergence_quarantines_total")
+                - q0 >= 1)
+        assert auditor.snapshot().get(0, 0) >= 1
+        assert scrubber.corrupt_blocks >= 1     # the heal really ran
+        # detected -> quarantined -> healed -> re-admitted: the final
+        # sweep is bit-identical to the fault-free run
+        final = {q: fe.query(*q, timeout=60) for q in pool}
+        assert all(r.ok for r in final.values())
+        assert {q: (r.cost, r.plen, r.finished)
+                for q, r in final.items()} == truth
+
+        # phase B: wire rot. Stop the auditor first so the injection
+        # is deterministically consumed by the SERVING dispatch.
+        auditor.stop()
+        monkeypatch.setenv("DOS_FAULTS", "corrupt-answer;times=1")
+        faults.reset()
+        m0 = _counter("answer_fp_mismatch_total")
+        f0 = _counter("failover_total")
+        wired = {q: fe.query(*q, timeout=60) for q in pool}
+        assert all(r.ok for r in wired.values())
+        assert {q: (r.cost, r.plen, r.finished)
+                for q, r in wired.items()} == truth
+        assert _counter("answer_fp_mismatch_total") - m0 >= 1
+        assert _counter("failover_total") - f0 >= 1
+    finally:
+        daemon.stop()
+        fe.stop()
+        auditor.stop()
+        scrubber.stop()
+        reg.shutdown()
+        obs_recorder.set_recorder(None)
+        rec.close()
+        monkeypatch.delenv("DOS_FAULTS", raising=False)
+        faults.reset()
+    # the causal chain on tape: fault fired -> audit caught it ->
+    # scrub healed inside the quarantine actuator -> shard re-admitted
+    kinds = [r["kind"] for r in obs_recorder.replay(str(tmp_path / "tape"))
+             if r.get("rec") == "event"]
+    for kind in ("fault", "audit_divergence", "scrub_corrupt",
+                 "control_divergence_quarantine", "control_readmit"):
+        assert kind in kinds, kind
+    assert kinds.index("fault") < kinds.index("audit_divergence")
+    assert (kinds.index("audit_divergence")
+            < kinds.index("scrub_corrupt")
+            < kinds.index("control_readmit"))
+    assert (kinds.index("control_divergence_quarantine")
+            < kinds.index("control_readmit"))
+    text = obs_recorder.render_timeline(
+        obs_recorder.replay(str(tmp_path / "tape")))
+    assert "audit_divergence" in text and "scrub_corrupt" in text
